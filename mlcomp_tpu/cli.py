@@ -1,0 +1,98 @@
+"""Command-line entry point: ``mlcomp-tpu <command>``.
+
+Mirrors the reference's CLI surface (``mlcomp dag <yaml>`` submit path,
+supervisor/worker daemons, report UI — BASELINE.json:5).  Commands grow as
+subsystems land; each subcommand imports lazily so ``validate`` works
+without JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_validate(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.dag import parse_dag, topo_sort
+
+    dag = parse_dag(args.config)
+    order = topo_sort(dag.tasks)
+    print(f"dag {dag.name!r} (project {dag.project!r}): {len(dag.tasks)} tasks")
+    for t in order:
+        deps = f" <- {list(t.depends)}" if t.depends else ""
+        print(f"  {t.name} [{t.executor}/{t.stage}] chips={t.resources.chips}{deps}")
+    return 0
+
+
+def _cmd_dag(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.scheduler.local import run_dag_local
+
+    results = run_dag_local(args.config, workers=args.workers)
+    bad = {n: s.value for n, s in results.items() if s.value != "success"}
+    print(json.dumps({n: s.value for n, s in results.items()}, indent=2))
+    return 1 if bad else 0
+
+
+def _cmd_supervisor(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.scheduler.supervisor import Supervisor
+    from mlcomp_tpu.db.store import Store
+
+    sup = Supervisor(Store(args.db))
+    sup.run_forever(poll_interval=args.poll)
+    return 0
+
+
+def _cmd_worker(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.scheduler.worker import Worker
+    from mlcomp_tpu.db.store import Store
+
+    w = Worker(Store(args.db), name=args.name, chips=args.chips)
+    w.run_forever(poll_interval=args.poll)
+    return 0
+
+
+def _cmd_report(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.report.server import serve
+
+    serve(db_path=args.db, host=args.host, port=args.port)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mlcomp-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("validate", help="parse + validate a DAG YAML")
+    v.add_argument("config")
+    v.set_defaults(fn=_cmd_validate)
+
+    d = sub.add_parser("dag", help="run a DAG locally (in-process scheduler)")
+    d.add_argument("config")
+    d.add_argument("--workers", type=int, default=1)
+    d.set_defaults(fn=_cmd_dag)
+
+    s = sub.add_parser("supervisor", help="run the supervisor daemon")
+    s.add_argument("--db", default="mlcomp.sqlite")
+    s.add_argument("--poll", type=float, default=1.0)
+    s.set_defaults(fn=_cmd_supervisor)
+
+    w = sub.add_parser("worker", help="run a worker daemon")
+    w.add_argument("--db", default="mlcomp.sqlite")
+    w.add_argument("--name", default=None)
+    w.add_argument("--chips", type=int, default=0)
+    w.add_argument("--poll", type=float, default=0.5)
+    w.set_defaults(fn=_cmd_worker)
+
+    r = sub.add_parser("report", help="run the report/UI HTTP server")
+    r.add_argument("--db", default="mlcomp.sqlite")
+    r.add_argument("--host", default="127.0.0.1")
+    r.add_argument("--port", type=int, default=8765)
+    r.set_defaults(fn=_cmd_report)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

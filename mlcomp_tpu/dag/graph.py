@@ -1,0 +1,99 @@
+"""DAG graph algorithms: validation, topological order, ready-set.
+
+The Supervisor needs (a) cycle detection at submit time and (b) the set of
+tasks whose dependencies are all satisfied, each scheduling tick (reference
+behavior: BASELINE.json:5 — "Supervisor/Worker scheduler").
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Iterable, List, Mapping, Set
+
+from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+
+
+class DagValidationError(ValueError):
+    pass
+
+
+def validate_dag(dag: DagSpec) -> None:
+    names = [t.name for t in dag.tasks]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise DagValidationError(f"duplicate task names: {sorted(dupes)}")
+    name_set = set(names)
+    for t in dag.tasks:
+        for d in t.depends:
+            if d not in name_set:
+                raise DagValidationError(
+                    f"task {t.name!r} depends on unknown task {d!r}"
+                )
+            if d == t.name:
+                raise DagValidationError(f"task {t.name!r} depends on itself")
+    topo_sort(dag.tasks)  # raises on cycle
+
+
+def topo_sort(tasks: Iterable[TaskSpec]) -> List[TaskSpec]:
+    """Kahn's algorithm; deterministic (input order) among ready tasks."""
+    tasks = list(tasks)
+    indeg: Dict[str, int] = {t.name: len(t.depends) for t in tasks}
+    dependents: Dict[str, List[str]] = {t.name: [] for t in tasks}
+    by_name = {t.name: t for t in tasks}
+    for t in tasks:
+        for d in t.depends:
+            dependents[d].append(t.name)
+    queue = deque([t.name for t in tasks if indeg[t.name] == 0])
+    order: List[TaskSpec] = []
+    while queue:
+        n = queue.popleft()
+        order.append(by_name[n])
+        for m in dependents[n]:
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                queue.append(m)
+    if len(order) != len(tasks):
+        stuck = sorted(set(by_name) - {t.name for t in order})
+        raise DagValidationError(f"cycle detected involving: {stuck}")
+    return order
+
+
+def ready_tasks(
+    tasks: Iterable[TaskSpec], statuses: Mapping[str, TaskStatus]
+) -> List[TaskSpec]:
+    """Tasks that are NOT_RAN and whose deps all succeeded.
+
+    A failed/skipped/stopped dependency does NOT make a task ready; the
+    scheduler marks such downstream tasks SKIPPED (see supervisor).
+    """
+    out = []
+    for t in tasks:
+        if statuses.get(t.name, TaskStatus.NOT_RAN) != TaskStatus.NOT_RAN:
+            continue
+        if all(statuses.get(d) == TaskStatus.SUCCESS for d in t.depends):
+            out.append(t)
+    return out
+
+
+def doomed_tasks(
+    tasks: Iterable[TaskSpec], statuses: Mapping[str, TaskStatus]
+) -> Set[str]:
+    """Transitive closure of tasks downstream of a failure/skip/stop."""
+    bad = {
+        n
+        for n, s in statuses.items()
+        if s in (TaskStatus.FAILED, TaskStatus.SKIPPED, TaskStatus.STOPPED)
+    }
+    tasks = list(tasks)
+    changed = True
+    doomed: Set[str] = set()
+    while changed:
+        changed = False
+        for t in tasks:
+            if t.name in bad or t.name in doomed:
+                continue
+            if any(d in bad or d in doomed for d in t.depends):
+                if statuses.get(t.name, TaskStatus.NOT_RAN) == TaskStatus.NOT_RAN:
+                    doomed.add(t.name)
+                    changed = True
+    return doomed

@@ -1,0 +1,4 @@
+from mlcomp_tpu.utils.registry import Registry
+from mlcomp_tpu.utils.config import load_config, merge_config, interpolate
+
+__all__ = ["Registry", "load_config", "merge_config", "interpolate"]

@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX loads.
+
+Real multi-chip hardware is unavailable in CI; sharding/collective tests run
+on XLA's host-platform virtual devices instead (same SPMD partitioner, same
+collective lowering).
+"""
+
+import os
+
+# Must be set before the first `import jax` anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_db(tmp_path):
+    return str(tmp_path / "mlcomp.sqlite")

@@ -1,0 +1,55 @@
+import os
+
+import pytest
+
+from mlcomp_tpu.utils.config import (
+    ConfigError,
+    interpolate,
+    load_config,
+    loads_config,
+    merge_config,
+)
+
+
+def test_merge_deep():
+    base = {"a": {"b": 1, "c": 2}, "d": [1, 2]}
+    out = merge_config(base, {"a": {"c": 3}, "d": [9]})
+    assert out == {"a": {"b": 1, "c": 3}, "d": [9]}
+    assert base["a"]["c"] == 2  # no mutation
+
+
+def test_interpolate_reference_keeps_type():
+    cfg = interpolate({"lr": 0.001, "opt": {"lr": "${lr}"}})
+    assert cfg["opt"]["lr"] == 0.001
+    assert isinstance(cfg["opt"]["lr"], float)
+
+
+def test_interpolate_string_embedding():
+    cfg = interpolate({"name": "exp", "path": "/tmp/${name}/run"})
+    assert cfg["path"] == "/tmp/exp/run"
+
+
+def test_interpolate_env(monkeypatch):
+    monkeypatch.setenv("MLC_TEST_VAR", "hello")
+    cfg = interpolate({"a": "${env:MLC_TEST_VAR}", "b": "${env:MISSING_X,fallback}"})
+    assert cfg == {"a": "hello", "b": "fallback"}
+
+
+def test_interpolate_missing_raises():
+    with pytest.raises(ConfigError):
+        interpolate({"a": "${nope.nope}"})
+
+
+def test_load_with_base(tmp_path):
+    (tmp_path / "base.yml").write_text("a: 1\nb: {c: 2}\n")
+    (tmp_path / "child.yml").write_text("_base_: base.yml\nb: {c: 3}\n")
+    cfg = load_config(tmp_path / "child.yml")
+    assert cfg == {"a": 1, "b": {"c": 3}}
+
+
+def test_loads_and_overrides(tmp_path):
+    p = tmp_path / "x.yml"
+    p.write_text("a: 1\nb: 2\n")
+    cfg = load_config(p, overrides={"b": 7})
+    assert cfg == {"a": 1, "b": 7}
+    assert loads_config("x: [1, 2]") == {"x": [1, 2]}

@@ -1,0 +1,104 @@
+import pytest
+
+from mlcomp_tpu.dag import parse_dag, topo_sort, ready_tasks
+from mlcomp_tpu.dag.graph import DagValidationError, doomed_tasks
+from mlcomp_tpu.dag.parser import expand_grid
+from mlcomp_tpu.dag.schema import TaskStatus
+from mlcomp_tpu.utils.config import ConfigError
+
+SIMPLE = """
+info: {name: demo, project: tests}
+executors:
+  prep:
+    type: preprocess
+  train:
+    type: train
+    stage: train
+    depends: prep
+    resources: {chips: 8}
+    args: {epochs: 2}
+  infer:
+    type: infer
+    stage: infer
+    depends: train
+"""
+
+
+def test_parse_simple():
+    dag = parse_dag(SIMPLE)
+    assert dag.name == "demo" and dag.project == "tests"
+    assert dag.task_names == ["prep", "train", "infer"]
+    t = dag.task("train")
+    assert t.depends == ("prep",)
+    assert t.resources.chips == 8
+    assert t.args == {"epochs": 2}
+    assert t.stage == "train"
+
+
+def test_topo_order():
+    dag = parse_dag(SIMPLE)
+    order = [t.name for t in topo_sort(dag.tasks)]
+    assert order.index("prep") < order.index("train") < order.index("infer")
+
+
+def test_cycle_detected():
+    bad = """
+info: {name: cyc}
+executors:
+  a: {type: x, depends: b}
+  b: {type: x, depends: a}
+"""
+    with pytest.raises(DagValidationError):
+        parse_dag(bad)
+
+
+def test_unknown_dep():
+    bad = """
+info: {name: bad}
+executors:
+  a: {type: x, depends: ghost}
+"""
+    with pytest.raises(ConfigError):
+        parse_dag(bad)
+
+
+def test_grid_expansion():
+    grid_yaml = """
+info: {name: grid}
+executors:
+  train:
+    type: train
+    grid:
+      lr: [0.1, 0.01]
+      model.width: [64, 128]
+    args: {model: {depth: 3}, epochs: 1}
+  report:
+    type: submit
+    depends: train
+"""
+    dag = parse_dag(grid_yaml)
+    train_tasks = [t for t in dag.tasks if t.name.startswith("train")]
+    assert len(train_tasks) == 4
+    assert train_tasks[0].name == "train[0]"
+    # grid params override nested args, base args preserved
+    assert train_tasks[0].args == {"model": {"depth": 3, "width": 64}, "epochs": 1, "lr": 0.1}
+    assert train_tasks[3].args["lr"] == 0.01
+    assert train_tasks[3].args["model"]["width"] == 128
+    # fan-in join
+    report = dag.task("report")
+    assert report.depends == ("train[0]", "train[1]", "train[2]", "train[3]")
+
+
+def test_expand_grid_no_grid():
+    assert expand_grid("t", {}, {"a": 1}) == [("t", {"a": 1}, ())]
+
+
+def test_ready_and_doomed():
+    dag = parse_dag(SIMPLE)
+    st = {n: TaskStatus.NOT_RAN for n in dag.task_names}
+    ready = ready_tasks(dag.tasks, st)
+    assert [t.name for t in ready] == ["prep"]
+    st["prep"] = TaskStatus.SUCCESS
+    assert [t.name for t in ready_tasks(dag.tasks, st)] == ["train"]
+    st["train"] = TaskStatus.FAILED
+    assert doomed_tasks(dag.tasks, st) == {"infer"}

@@ -1,0 +1,49 @@
+import pytest
+
+from mlcomp_tpu.utils.registry import Registry, RegistryError
+
+
+def test_register_and_get_case_insensitive():
+    r = Registry("things")
+
+    @r.register("My-Thing")
+    class Thing:
+        pass
+
+    assert r.get("my_thing") is Thing
+    assert "MY-THING" in r
+    assert len(r) == 1
+
+
+def test_duplicate_raises():
+    r = Registry("things")
+    r.register("a", obj=object())
+    with pytest.raises(RegistryError):
+        r.register("a", obj=object())
+
+
+def test_same_object_reregister_ok():
+    r = Registry("things")
+    o = object()
+    r.register("a", obj=o)
+    r.register("a", obj=o)  # idempotent
+    assert len(r) == 1
+
+
+def test_unknown_lists_known():
+    r = Registry("things")
+    r.register("alpha", obj=object())
+    with pytest.raises(RegistryError, match="alpha"):
+        r.get("beta")
+
+
+def test_create():
+    r = Registry("things")
+
+    @r.register("pair")
+    class Pair:
+        def __init__(self, x, y=0):
+            self.x, self.y = x, y
+
+    p = r.create("pair", 1, y=2)
+    assert (p.x, p.y) == (1, 2)
