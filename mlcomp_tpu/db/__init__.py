@@ -1,0 +1,3 @@
+from mlcomp_tpu.db.store import Store
+
+__all__ = ["Store"]

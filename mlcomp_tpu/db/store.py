@@ -1,0 +1,361 @@
+"""Embedded task store: dags, tasks, logs, metrics, workers.
+
+The reference coordinates Supervisor/Workers through a shared PostgreSQL
+database plus Redis (upstream mlcomp; BASELINE.json:5 keeps "the report
+server and model storage ... on the TPU-VM host disk").  On a TPU-VM pod
+there is no separate DB host — the natural TPU-native choice is an embedded
+sqlite file on the head host's disk, WAL-journaled so many worker processes
+can read/write concurrently, with claim semantics done as atomic UPDATEs
+(no Redis needed).
+
+All multi-process coordination goes through this one file; every method
+opens a short transaction so crash recovery is just "reopen the file".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from mlcomp_tpu.dag.schema import DagSpec, ResourceSpec, TaskSpec, TaskStatus
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS dags (
+    id       INTEGER PRIMARY KEY AUTOINCREMENT,
+    name     TEXT NOT NULL,
+    project  TEXT NOT NULL,
+    config   TEXT NOT NULL,
+    status   TEXT NOT NULL DEFAULT 'in_progress',
+    created  REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS tasks (
+    id          INTEGER PRIMARY KEY AUTOINCREMENT,
+    dag_id      INTEGER NOT NULL REFERENCES dags(id),
+    name        TEXT NOT NULL,
+    executor    TEXT NOT NULL,
+    stage       TEXT NOT NULL,
+    args        TEXT NOT NULL,
+    depends     TEXT NOT NULL,
+    chips       INTEGER NOT NULL DEFAULT 0,
+    hosts       INTEGER NOT NULL DEFAULT 1,
+    priority    INTEGER NOT NULL DEFAULT 0,
+    max_retries INTEGER NOT NULL DEFAULT 0,
+    retries     INTEGER NOT NULL DEFAULT 0,
+    status      TEXT NOT NULL DEFAULT 'not_ran',
+    worker      TEXT,
+    started     REAL,
+    finished    REAL,
+    error       TEXT,
+    result      TEXT,
+    UNIQUE (dag_id, name)
+);
+CREATE INDEX IF NOT EXISTS idx_tasks_status ON tasks (dag_id, status);
+CREATE TABLE IF NOT EXISTS logs (
+    id      INTEGER PRIMARY KEY AUTOINCREMENT,
+    task_id INTEGER NOT NULL,
+    ts      REAL NOT NULL,
+    level   TEXT NOT NULL,
+    message TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS metrics (
+    id      INTEGER PRIMARY KEY AUTOINCREMENT,
+    task_id INTEGER NOT NULL,
+    ts      REAL NOT NULL,
+    name    TEXT NOT NULL,
+    step    INTEGER NOT NULL DEFAULT 0,
+    value   REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_metrics_task ON metrics (task_id, name, step);
+CREATE TABLE IF NOT EXISTS workers (
+    name      TEXT PRIMARY KEY,
+    chips     INTEGER NOT NULL DEFAULT 0,
+    busy_chips INTEGER NOT NULL DEFAULT 0,
+    heartbeat REAL NOT NULL,
+    status    TEXT NOT NULL DEFAULT 'alive'
+);
+"""
+
+
+class Store:
+    """One sqlite connection per Store instance (per process/thread)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._conn = sqlite3.connect(path, timeout=30.0)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    @contextmanager
+    def _tx(self):
+        try:
+            yield self._conn
+            self._conn.commit()
+        except Exception:
+            self._conn.rollback()
+            raise
+
+    # ------------------------------------------------------------------ dags
+
+    def submit_dag(self, dag: DagSpec) -> int:
+        """Insert the dag and all its tasks as NOT_RAN; returns dag_id."""
+        with self._tx() as c:
+            cur = c.execute(
+                "INSERT INTO dags (name, project, config, created) VALUES (?,?,?,?)",
+                (dag.name, dag.project, json.dumps(dag.config), time.time()),
+            )
+            dag_id = int(cur.lastrowid)
+            for t in dag.tasks:
+                c.execute(
+                    "INSERT INTO tasks (dag_id, name, executor, stage, args, depends,"
+                    " chips, hosts, priority, max_retries, status)"
+                    " VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                    (
+                        dag_id,
+                        t.name,
+                        t.executor,
+                        t.stage,
+                        json.dumps(t.args),
+                        json.dumps(list(t.depends)),
+                        t.resources.chips,
+                        t.resources.hosts,
+                        t.resources.priority,
+                        t.max_retries,
+                        TaskStatus.NOT_RAN.value,
+                    ),
+                )
+        return dag_id
+
+    def dag_status(self, dag_id: int) -> str:
+        row = self._conn.execute(
+            "SELECT status FROM dags WHERE id=?", (dag_id,)
+        ).fetchone()
+        if row is None:
+            raise KeyError(f"no dag {dag_id}")
+        return row["status"]
+
+    def set_dag_status(self, dag_id: int, status: str) -> None:
+        with self._tx() as c:
+            c.execute("UPDATE dags SET status=? WHERE id=?", (status, dag_id))
+
+    def list_dags(self) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            "SELECT id, name, project, status, created FROM dags ORDER BY id"
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    # ----------------------------------------------------------------- tasks
+
+    def task_specs(self, dag_id: int) -> List[TaskSpec]:
+        rows = self._conn.execute(
+            "SELECT * FROM tasks WHERE dag_id=? ORDER BY id", (dag_id,)
+        ).fetchall()
+        return [self._row_to_spec(r) for r in rows]
+
+    @staticmethod
+    def _row_to_spec(r: sqlite3.Row) -> TaskSpec:
+        return TaskSpec(
+            name=r["name"],
+            executor=r["executor"],
+            args=json.loads(r["args"]),
+            depends=tuple(json.loads(r["depends"])),
+            stage=r["stage"],
+            resources=ResourceSpec(
+                chips=r["chips"], hosts=r["hosts"], priority=r["priority"]
+            ),
+            max_retries=r["max_retries"],
+        )
+
+    def task_statuses(self, dag_id: int) -> Dict[str, TaskStatus]:
+        rows = self._conn.execute(
+            "SELECT name, status FROM tasks WHERE dag_id=?", (dag_id,)
+        ).fetchall()
+        return {r["name"]: TaskStatus(r["status"]) for r in rows}
+
+    def task_rows(self, dag_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            "SELECT * FROM tasks WHERE dag_id=? ORDER BY id", (dag_id,)
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def set_task_status(
+        self,
+        dag_id: int,
+        names: Iterable[str],
+        status: TaskStatus,
+        expect: Optional[TaskStatus] = None,
+    ) -> int:
+        """Set status; with ``expect``, only transition rows still in that
+        state (conditional UPDATE — safe under concurrent supervisors whose
+        snapshots may be stale).  Returns number of rows changed."""
+        changed = 0
+        with self._tx() as c:
+            for n in names:
+                if expect is None:
+                    cur = c.execute(
+                        "UPDATE tasks SET status=? WHERE dag_id=? AND name=?",
+                        (status.value, dag_id, n),
+                    )
+                else:
+                    cur = c.execute(
+                        "UPDATE tasks SET status=? WHERE dag_id=? AND name=? AND status=?",
+                        (status.value, dag_id, n, expect.value),
+                    )
+                changed += cur.rowcount
+        return changed
+
+    def claim_task(
+        self, worker: str, free_chips: int, free_hosts: int = 1
+    ) -> Optional[Dict[str, Any]]:
+        """Atomically claim the highest-priority queued task that fits.
+
+        The UPDATE is conditional on status still being 'queued', which makes
+        the claim race-free across worker processes sharing the file (this is
+        the sqlite equivalent of the reference's Redis-locked assignment).
+        """
+        while True:
+            row = self._conn.execute(
+                "SELECT id FROM tasks WHERE status=? AND chips<=? AND hosts<=?"
+                " ORDER BY priority DESC, id ASC LIMIT 1",
+                (TaskStatus.QUEUED.value, free_chips, free_hosts),
+            ).fetchone()
+            if row is None:
+                return None
+            with self._tx() as c:
+                cur = c.execute(
+                    "UPDATE tasks SET status=?, worker=?, started=?"
+                    " WHERE id=? AND status=?",
+                    (
+                        TaskStatus.IN_PROGRESS.value,
+                        worker,
+                        time.time(),
+                        row["id"],
+                        TaskStatus.QUEUED.value,
+                    ),
+                )
+                if cur.rowcount == 1:
+                    got = self._conn.execute(
+                        "SELECT * FROM tasks WHERE id=?", (row["id"],)
+                    ).fetchone()
+                    return dict(got)
+            # lost the race; try the next queued task
+
+    def finish_task(
+        self,
+        task_id: int,
+        status: TaskStatus,
+        error: Optional[str] = None,
+        result: Optional[Dict[str, Any]] = None,
+        expect_worker: Optional[str] = None,
+    ) -> bool:
+        """Finish a task; with ``expect_worker``, only if still assigned to
+        that worker and in progress (a stale worker whose task was reaped and
+        requeued must not clobber the re-execution)."""
+        q = "UPDATE tasks SET status=?, finished=?, error=?, result=? WHERE id=?"
+        params: list = [
+            status.value,
+            time.time(),
+            error,
+            json.dumps(result) if result is not None else None,
+            task_id,
+        ]
+        if expect_worker is not None:
+            q += " AND worker=? AND status=?"
+            params += [expect_worker, TaskStatus.IN_PROGRESS.value]
+        with self._tx() as c:
+            cur = c.execute(q, params)
+            return cur.rowcount == 1
+
+    def requeue_task(self, task_id: int) -> bool:
+        """Put a task back in the queue, consuming one retry. False if spent."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE tasks SET status=?, worker=NULL, started=NULL,"
+                " retries=retries+1 WHERE id=? AND retries < max_retries",
+                (TaskStatus.QUEUED.value, task_id),
+            )
+            return cur.rowcount == 1
+
+    def tasks_on_worker(self, worker: str) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            "SELECT * FROM tasks WHERE worker=? AND status=?",
+            (worker, TaskStatus.IN_PROGRESS.value),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    # ------------------------------------------------------------ logs/metrics
+
+    def log(self, task_id: int, level: str, message: str) -> None:
+        with self._tx() as c:
+            c.execute(
+                "INSERT INTO logs (task_id, ts, level, message) VALUES (?,?,?,?)",
+                (task_id, time.time(), level, message),
+            )
+
+    def task_logs(self, task_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            "SELECT ts, level, message FROM logs WHERE task_id=? ORDER BY id",
+            (task_id,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def metric(self, task_id: int, name: str, value: float, step: int = 0) -> None:
+        with self._tx() as c:
+            c.execute(
+                "INSERT INTO metrics (task_id, ts, name, step, value) VALUES (?,?,?,?,?)",
+                (task_id, time.time(), name, step, float(value)),
+            )
+
+    def metric_series(self, task_id: int, name: str) -> List[Tuple[int, float]]:
+        rows = self._conn.execute(
+            "SELECT step, value FROM metrics WHERE task_id=? AND name=? ORDER BY step",
+            (task_id, name),
+        ).fetchall()
+        return [(r["step"], r["value"]) for r in rows]
+
+    def metric_names(self, task_id: int) -> List[str]:
+        rows = self._conn.execute(
+            "SELECT DISTINCT name FROM metrics WHERE task_id=? ORDER BY name",
+            (task_id,),
+        ).fetchall()
+        return [r["name"] for r in rows]
+
+    # --------------------------------------------------------------- workers
+
+    def heartbeat(self, worker: str, chips: int, busy_chips: int = 0) -> None:
+        with self._tx() as c:
+            c.execute(
+                "INSERT INTO workers (name, chips, busy_chips, heartbeat, status)"
+                " VALUES (?,?,?,?,'alive')"
+                " ON CONFLICT(name) DO UPDATE SET chips=excluded.chips,"
+                " busy_chips=excluded.busy_chips, heartbeat=excluded.heartbeat,"
+                " status='alive'",
+                (worker, chips, busy_chips, time.time()),
+            )
+
+    def workers(self) -> List[Dict[str, Any]]:
+        rows = self._conn.execute("SELECT * FROM workers ORDER BY name").fetchall()
+        return [dict(r) for r in rows]
+
+    def dead_workers(self, timeout_s: float) -> List[str]:
+        cutoff = time.time() - timeout_s
+        rows = self._conn.execute(
+            "SELECT name FROM workers WHERE status='alive' AND heartbeat < ?",
+            (cutoff,),
+        ).fetchall()
+        return [r["name"] for r in rows]
+
+    def mark_worker_dead(self, worker: str) -> None:
+        with self._tx() as c:
+            c.execute("UPDATE workers SET status='dead' WHERE name=?", (worker,))

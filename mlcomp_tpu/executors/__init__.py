@@ -1,0 +1,29 @@
+from mlcomp_tpu.executors.base import (
+    EXECUTORS,
+    ExecutionContext,
+    Executor,
+    create_executor,
+)
+
+# Import built-in executors so registration side effects run.
+from mlcomp_tpu.executors import basic as _basic  # noqa: F401
+
+__all__ = ["EXECUTORS", "ExecutionContext", "Executor", "create_executor"]
+
+
+def load_all() -> None:
+    """Import every executor module (including JAX ones) for registration.
+
+    Modules that have not been built yet are tolerated (exact-name
+    ModuleNotFoundError only); a broken import *inside* an existing module
+    still raises, so real bugs are never masked as "unknown executor".
+    """
+    import importlib
+
+    for mod in ("train", "infer"):
+        name = f"mlcomp_tpu.executors.{mod}"
+        try:
+            importlib.import_module(name)
+        except ModuleNotFoundError as e:
+            if e.name != name:
+                raise

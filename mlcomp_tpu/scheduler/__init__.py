@@ -1,0 +1,5 @@
+from mlcomp_tpu.scheduler.supervisor import Supervisor
+from mlcomp_tpu.scheduler.worker import Worker
+from mlcomp_tpu.scheduler.local import run_dag_local
+
+__all__ = ["Supervisor", "Worker", "run_dag_local"]

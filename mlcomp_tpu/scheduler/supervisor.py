@@ -1,0 +1,89 @@
+"""Supervisor: DAG progression + failure detection.
+
+The reference's Supervisor assigns DAG tasks to per-GPU Docker workers and
+restarts work lost to dead workers (reference behavior: BASELINE.json:5 —
+"the Supervisor/Worker scheduler provisions and pins TPU-VM slices in place
+of per-GPU Docker workers").  This Supervisor is stateless between ticks:
+every decision is recomputed from the store, so it can crash and resume, or
+run as several replicas, without extra coordination.
+
+Per tick, for every in-progress DAG:
+  1. queue tasks whose dependencies all succeeded;
+  2. skip tasks doomed by an upstream failure/stop;
+  3. requeue (within retry budget) or fail tasks stranded on dead workers;
+  4. finalize the DAG when every task reached a terminal status.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from mlcomp_tpu.dag.graph import doomed_tasks, ready_tasks
+from mlcomp_tpu.dag.schema import TaskStatus
+from mlcomp_tpu.db.store import Store
+
+
+class Supervisor:
+    def __init__(self, store: Store, worker_timeout_s: float = 30.0):
+        self.store = store
+        self.worker_timeout_s = worker_timeout_s
+
+    def tick(self) -> Dict[int, str]:
+        """One scheduling pass over all live DAGs; returns dag_id → status."""
+        self._reap_dead_workers()
+        out: Dict[int, str] = {}
+        for dag in self.store.list_dags():
+            if dag["status"] != "in_progress":
+                out[dag["id"]] = dag["status"]
+                continue
+            out[dag["id"]] = self._advance_dag(dag["id"])
+        return out
+
+    def _advance_dag(self, dag_id: int) -> str:
+        specs = self.store.task_specs(dag_id)
+        statuses = self.store.task_statuses(dag_id)
+
+        # Conditional transitions (expect=NOT_RAN) keep concurrent supervisor
+        # replicas with stale snapshots from re-queueing finished work.
+        ready = ready_tasks(specs, statuses)
+        if ready:
+            self.store.set_task_status(
+                dag_id,
+                [t.name for t in ready],
+                TaskStatus.QUEUED,
+                expect=TaskStatus.NOT_RAN,
+            )
+        doomed = doomed_tasks(specs, statuses)
+        if doomed:
+            self.store.set_task_status(
+                dag_id, doomed, TaskStatus.SKIPPED, expect=TaskStatus.NOT_RAN
+            )
+
+        statuses = self.store.task_statuses(dag_id)
+        if all(s.finished for s in statuses.values()):
+            final = (
+                "success"
+                if all(s == TaskStatus.SUCCESS for s in statuses.values())
+                else "failed"
+            )
+            self.store.set_dag_status(dag_id, final)
+            return final
+        return "in_progress"
+
+    def _reap_dead_workers(self) -> None:
+        """Requeue or fail tasks stranded on workers that stopped heartbeating."""
+        for name in self.store.dead_workers(self.worker_timeout_s):
+            for task in self.store.tasks_on_worker(name):
+                if not self.store.requeue_task(task["id"]):
+                    self.store.finish_task(
+                        task["id"],
+                        TaskStatus.FAILED,
+                        error=f"worker {name!r} died and retries exhausted",
+                    )
+            self.store.mark_worker_dead(name)
+
+    def run_forever(self, poll_interval: float = 1.0) -> None:
+        while True:
+            self.tick()
+            time.sleep(poll_interval)

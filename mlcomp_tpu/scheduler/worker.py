@@ -1,0 +1,116 @@
+"""Worker: claims tasks from the store and runs executors.
+
+The reference runs one Docker worker per GPU; here a worker represents a
+TPU-VM host (or a CPU-only host) advertising some number of TPU chips
+(reference behavior: BASELINE.json:5).  Claiming is an atomic conditional
+UPDATE in the store, so any number of worker processes can share one queue
+without a lock service.
+
+While an executor runs (minutes to hours for training tasks), a background
+thread keeps heartbeating so the Supervisor's failure detector does not
+reap a healthy-but-busy worker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from mlcomp_tpu.dag.schema import TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+
+def default_worker_name() -> str:
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+class Worker:
+    def __init__(
+        self,
+        store: Store,
+        name: Optional[str] = None,
+        chips: int = 0,
+        hosts: int = 1,
+        workdir: str = ".",
+        heartbeat_interval_s: float = 5.0,
+        load_jax_executors: bool = True,
+    ):
+        self.store = store
+        self.name = name or default_worker_name()
+        self.chips = chips
+        self.hosts = hosts
+        self.workdir = workdir
+        self.heartbeat_interval_s = heartbeat_interval_s
+        if load_jax_executors:
+            from mlcomp_tpu import executors
+
+            executors.load_all()
+
+    def _heartbeat_pump(self, busy_chips: int, stop: threading.Event) -> None:
+        """Own-connection heartbeat loop (sqlite connections are per-thread)."""
+        hb_store = Store(self.store.path)
+        try:
+            while not stop.wait(self.heartbeat_interval_s):
+                hb_store.heartbeat(self.name, self.chips, busy_chips=busy_chips)
+        finally:
+            hb_store.close()
+
+    def run_once(self) -> bool:
+        """Claim and execute at most one task. Returns True if one ran."""
+        self.store.heartbeat(self.name, self.chips)
+        claim = self.store.claim_task(
+            self.name, free_chips=self.chips, free_hosts=self.hosts
+        )
+        if claim is None:
+            return False
+        self.store.heartbeat(self.name, self.chips, busy_chips=claim["chips"])
+        stop = threading.Event()
+        pump = threading.Thread(
+            target=self._heartbeat_pump, args=(claim["chips"], stop), daemon=True
+        )
+        pump.start()
+        try:
+            ctx = ExecutionContext(
+                dag_id=claim["dag_id"],
+                task_id=claim["id"],
+                task_name=claim["name"],
+                args=json.loads(claim["args"]),
+                store=self.store,
+                workdir=self.workdir,
+                chips=claim["chips"],
+                stage=claim["stage"],
+            )
+            ok, result, err = run_task(claim["executor"], ctx)
+        finally:
+            stop.set()
+            pump.join(timeout=self.heartbeat_interval_s + 1.0)
+        # expect_worker guards against a reaped-and-requeued task being
+        # clobbered by this (stale) worker finishing late.
+        if ok:
+            self.store.finish_task(
+                claim["id"],
+                TaskStatus.SUCCESS,
+                result=result,
+                expect_worker=self.name,
+            )
+        else:
+            self.store.log(claim["id"], "error", err or "unknown error")
+            if not self.store.requeue_task(claim["id"]):
+                self.store.finish_task(
+                    claim["id"],
+                    TaskStatus.FAILED,
+                    error=err,
+                    expect_worker=self.name,
+                )
+        self.store.heartbeat(self.name, self.chips, busy_chips=0)
+        return True
+
+    def run_forever(self, poll_interval: float = 0.5) -> None:
+        while True:
+            if not self.run_once():
+                time.sleep(poll_interval)

@@ -8,7 +8,10 @@ collective lowering).
 import os
 
 # Must be set before the first `import jax` anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override (not setdefault): the ambient environment pins
+# JAX_PLATFORMS to the real TPU backend, whose init can take ~minutes and
+# which tests must never depend on.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

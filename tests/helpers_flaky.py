@@ -1,0 +1,12 @@
+"""Helper for retry tests: fails on first call, succeeds after (file-marked)."""
+
+from pathlib import Path
+
+
+def fail_once(ctx=None, marker: str = ""):
+    p = Path(marker)
+    attempts = p.read_text() if p.exists() else ""
+    p.write_text(attempts + "1")
+    if len(attempts) == 0:
+        raise RuntimeError("first attempt always fails")
+    return {"attempts": len(attempts) + 1}

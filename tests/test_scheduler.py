@@ -1,0 +1,132 @@
+import json
+import time
+
+from mlcomp_tpu.dag.parser import parse_dag
+from mlcomp_tpu.dag.schema import TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.scheduler.local import run_dag_local
+from mlcomp_tpu.scheduler.supervisor import Supervisor
+from mlcomp_tpu.scheduler.worker import Worker
+
+
+def test_linear_dag_end_to_end(tmp_db):
+    statuses = run_dag_local(
+        """
+info: {name: lin}
+executors:
+  a: {type: noop}
+  b: {type: noop, depends: a}
+  c: {type: noop, depends: b}
+""",
+        db_path=tmp_db,
+    )
+    assert all(s == TaskStatus.SUCCESS for s in statuses.values())
+
+
+def test_failure_skips_downstream(tmp_db):
+    statuses = run_dag_local(
+        """
+info: {name: f}
+executors:
+  a: {type: noop}
+  boom: {type: fail, depends: a}
+  after: {type: noop, depends: boom}
+  side: {type: noop, depends: a}
+""",
+        db_path=tmp_db,
+    )
+    assert statuses["a"] == TaskStatus.SUCCESS
+    assert statuses["boom"] == TaskStatus.FAILED
+    assert statuses["after"] == TaskStatus.SKIPPED
+    assert statuses["side"] == TaskStatus.SUCCESS
+
+
+def test_retry_then_success(tmp_db, tmp_path):
+    # a pyfunc that fails once then succeeds, via a file-based counter
+    marker = tmp_path / "attempts.txt"
+    statuses = run_dag_local(
+        {
+            "info": {"name": "retry"},
+            "executors": {
+                "flaky": {
+                    "type": "pyfunc",
+                    "max_retries": 2,
+                    "args": {
+                        "target": "tests.helpers_flaky:fail_once",
+                        "kwargs": {"marker": str(marker)},
+                    },
+                }
+            },
+        },
+        db_path=tmp_db,
+    )
+    assert statuses["flaky"] == TaskStatus.SUCCESS
+    assert marker.read_text() == "11"  # two attempts recorded
+
+
+def test_grid_fanout_parallel_workers(tmp_db):
+    statuses = run_dag_local(
+        """
+info: {name: grid}
+executors:
+  train:
+    type: noop
+    grid: {lr: [1, 2, 3, 4]}
+  join: {type: noop, depends: train}
+""",
+        workers=4,
+        db_path=tmp_db,
+    )
+    assert len(statuses) == 5
+    assert all(s == TaskStatus.SUCCESS for s in statuses.values())
+
+
+def test_dead_worker_requeue(tmp_db):
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        parse_dag(
+            "info: {name: dw}\nexecutors:\n  a: {type: noop, max_retries: 1}"
+        )
+    )
+    sup = Supervisor(store, worker_timeout_s=0.01)
+    sup.tick()  # queues 'a'
+    # worker claims then "dies" (no more heartbeats, task left in_progress)
+    dead = Store(tmp_db)
+    dead.heartbeat("zombie", chips=0)
+    claim = dead.claim_task("zombie", free_chips=0)
+    assert claim is not None
+    time.sleep(0.05)
+    sup.tick()  # reaps zombie, requeues task
+    assert store.task_statuses(dag_id)["a"] == TaskStatus.QUEUED
+    # a healthy worker finishes it
+    w = Worker(Store(tmp_db), name="healthy", chips=0)
+    assert w.run_once() is True
+    sup.tick()
+    assert store.dag_status(dag_id) == "success"
+
+
+def test_shell_and_submit_executors(tmp_db, tmp_path):
+    art = tmp_path / "model.bin"
+    statuses = run_dag_local(
+        {
+            "info": {"name": "pkg"},
+            "executors": {
+                "make": {
+                    "type": "shell",
+                    "args": {"command": f"echo weights > {art}"},
+                },
+                "pack": {
+                    "type": "submit",
+                    "depends": "make",
+                    "args": {
+                        "sources": [str(art)],
+                        "out": str(tmp_path / "sub.tar.gz"),
+                    },
+                },
+            },
+        },
+        db_path=tmp_db,
+        workdir=str(tmp_path),
+    )
+    assert all(s == TaskStatus.SUCCESS for s in statuses.values())
+    assert (tmp_path / "sub.tar.gz").exists()

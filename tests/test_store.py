@@ -1,0 +1,90 @@
+from mlcomp_tpu.dag.parser import parse_dag
+from mlcomp_tpu.dag.schema import TaskStatus
+from mlcomp_tpu.db.store import Store
+
+DAG = """
+info: {name: s, project: t}
+executors:
+  a: {type: noop}
+  b: {type: noop, depends: a, resources: {chips: 4}, max_retries: 1}
+"""
+
+
+def test_submit_and_roundtrip(tmp_db):
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(parse_dag(DAG))
+    specs = store.task_specs(dag_id)
+    assert [t.name for t in specs] == ["a", "b"]
+    assert specs[1].resources.chips == 4
+    assert specs[1].depends == ("a",)
+    assert store.task_statuses(dag_id) == {
+        "a": TaskStatus.NOT_RAN,
+        "b": TaskStatus.NOT_RAN,
+    }
+
+
+def test_claim_respects_resources_and_priority(tmp_db):
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        parse_dag(
+            """
+info: {name: p}
+executors:
+  small: {type: noop, resources: {chips: 1}}
+  big: {type: noop, resources: {chips: 8, priority: 5}}
+"""
+        )
+    )
+    store.set_task_status(dag_id, ["small", "big"], TaskStatus.QUEUED)
+    # only 2 chips free -> big (higher priority) does not fit, small claimed
+    got = store.claim_task("w1", free_chips=2)
+    assert got["name"] == "small"
+    # 8 chips free -> big now claimable
+    got2 = store.claim_task("w2", free_chips=8)
+    assert got2["name"] == "big"
+    # nothing left
+    assert store.claim_task("w3", free_chips=8) is None
+
+
+def test_claim_is_exclusive(tmp_db):
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(parse_dag("info: {name: x}\nexecutors:\n  a: {type: noop}"))
+    store.set_task_status(dag_id, ["a"], TaskStatus.QUEUED)
+    s2 = Store(tmp_db)
+    first = store.claim_task("w1", free_chips=0)
+    second = s2.claim_task("w2", free_chips=0)
+    assert first is not None and second is None
+
+
+def test_retry_budget(tmp_db):
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(parse_dag(DAG))
+    store.set_task_status(dag_id, ["b"], TaskStatus.QUEUED)
+    t = store.claim_task("w", free_chips=8)
+    assert store.requeue_task(t["id"]) is True  # max_retries=1
+    t = store.claim_task("w", free_chips=8)
+    assert store.requeue_task(t["id"]) is False  # budget spent
+
+
+def test_logs_and_metrics(tmp_db):
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(parse_dag(DAG))
+    tid = store.task_rows(dag_id)[0]["id"]
+    store.log(tid, "info", "hello")
+    store.metric(tid, "loss", 1.5, step=0)
+    store.metric(tid, "loss", 0.5, step=1)
+    assert store.task_logs(tid)[0]["message"] == "hello"
+    assert store.metric_series(tid, "loss") == [(0, 1.5), (1, 0.5)]
+    assert store.metric_names(tid) == ["loss"]
+
+
+def test_worker_heartbeat_and_death(tmp_db):
+    import time
+
+    store = Store(tmp_db)
+    store.heartbeat("w1", chips=8)
+    assert store.dead_workers(timeout_s=10.0) == []
+    time.sleep(0.05)
+    assert store.dead_workers(timeout_s=0.01) == ["w1"]
+    store.mark_worker_dead("w1")
+    assert store.dead_workers(timeout_s=0.01) == []
