@@ -1,0 +1,4 @@
+from mlcomp_tpu.data.datasets import DATASETS, create_dataset
+from mlcomp_tpu.data.loader import DataLoader
+
+__all__ = ["DATASETS", "create_dataset", "DataLoader"]

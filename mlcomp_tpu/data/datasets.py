@@ -1,0 +1,135 @@
+"""Dataset registry: in-memory numpy datasets.
+
+The reference's data layer is torch Datasets consumed by Catalyst loaders.
+Here a dataset is a dict of numpy arrays (``x``, ``y``) — the host-side
+representation the loader shards onto the device mesh.  Real corpora load
+from disk (``npz``/``image_folder``); synthetic generators cover the
+no-network environment and benchmarking (deterministic, seeded).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict
+
+import numpy as np
+
+from mlcomp_tpu.utils.registry import Registry
+
+DATASETS: Registry = Registry("datasets")
+
+
+@DATASETS.register("synthetic_classification")
+def synthetic_classification(
+    n: int = 1024,
+    num_classes: int = 10,
+    dim: int = 64,
+    seed: int = 0,
+    centers_seed: int = 42,
+    scale: float = 3.0,
+    **_,
+) -> Dict[str, np.ndarray]:
+    """Gaussian blobs: linearly separable-ish so training visibly learns.
+
+    ``centers_seed`` fixes the class structure independently of ``seed``
+    (which draws the samples), so train/valid splits with different seeds
+    come from the SAME distribution.
+    """
+    centers = (
+        np.random.RandomState(centers_seed).randn(num_classes, dim).astype(np.float32)
+        * scale
+    )
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, num_classes, size=n)
+    x = centers[y] + rng.randn(n, dim).astype(np.float32)
+    return {"x": x.astype(np.float32), "y": y.astype(np.int32)}
+
+
+@DATASETS.register("synth_mnist")
+def synth_mnist(n: int = 2048, seed: int = 0, **_) -> Dict[str, np.ndarray]:
+    """MNIST-shaped synthetic digits: class-dependent stroke patterns on a
+    28×28 canvas.  Stands in for the reference's MNIST DAG (BASELINE.json:7)
+    in the zero-egress environment; swap for `npz` with real MNIST on disk.
+    """
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    x = rng.rand(n, 28, 28).astype(np.float32) * 0.1
+    # deterministic class-dependent bright rectangles (learnable signal)
+    for i in range(n):
+        c = y[i]
+        r0, c0 = 2 + (c % 5) * 4, 2 + (c // 5) * 10
+        x[i, r0 : r0 + 6, c0 : c0 + 8] += 0.9
+    return {"x": np.clip(x, 0, 1)[..., None], "y": y.astype(np.int32)}
+
+
+@DATASETS.register("synthetic_images")
+def synthetic_images(
+    n: int = 256,
+    height: int = 224,
+    width: int = 224,
+    channels: int = 3,
+    num_classes: int = 1000,
+    seed: int = 0,
+    **_,
+) -> Dict[str, np.ndarray]:
+    """ImageNet-shaped random tensors — benchmarking input for ResNet-50."""
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.rand(n, height, width, channels).astype(np.float32),
+        "y": rng.randint(0, num_classes, size=n).astype(np.int32),
+    }
+
+
+@DATASETS.register("synthetic_segmentation")
+def synthetic_segmentation(
+    n: int = 64,
+    height: int = 128,
+    width: int = 128,
+    channels: int = 3,
+    num_classes: int = 4,
+    seed: int = 0,
+    **_,
+) -> Dict[str, np.ndarray]:
+    """Images with colored quadrant masks — U-Net DAG stand-in."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, height, width, channels).astype(np.float32) * 0.2
+    y = np.zeros((n, height, width), dtype=np.int32)
+    for i in range(n):
+        cls = rng.randint(1, num_classes)
+        h0, w0 = rng.randint(0, height // 2), rng.randint(0, width // 2)
+        h1, w1 = h0 + height // 3, w0 + width // 3
+        y[i, h0:h1, w0:w1] = cls
+        x[i, h0:h1, w0:w1, :] += 0.7 * cls / num_classes
+    return {"x": np.clip(x, 0, 1), "y": y}
+
+
+@DATASETS.register("synthetic_tokens")
+def synthetic_tokens(
+    n: int = 512,
+    seq_len: int = 128,
+    vocab_size: int = 1000,
+    num_classes: int = 2,
+    seed: int = 0,
+    **_,
+) -> Dict[str, np.ndarray]:
+    """Token sequences with a parity-of-first-tokens label — BERT stand-in."""
+    rng = np.random.RandomState(seed)
+    x = rng.randint(1, vocab_size, size=(n, seq_len)).astype(np.int32)
+    y = (x[:, :8].sum(axis=1) % num_classes).astype(np.int32)
+    return {"x": x, "y": y}
+
+
+@DATASETS.register("npz")
+def npz(path: str, x_key: str = "x", y_key: str = "y", **_) -> Dict[str, np.ndarray]:
+    """Load arrays from an .npz file on host disk (the model-storage path)."""
+    with np.load(Path(path)) as f:
+        return {"x": f[x_key], "y": f[y_key]}
+
+
+def create_dataset(cfg: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    cfg.pop("batch_size", None)  # loader arg, not dataset arg
+    cfg.pop("shuffle", None)
+    cfg.pop("drop_last", None)
+    return DATASETS.get(name)(**cfg)

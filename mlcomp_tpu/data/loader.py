@@ -1,0 +1,111 @@
+"""Batch loader: shuffle, batch, shard onto the device mesh, prefetch.
+
+The reference wraps torch DataLoaders (worker processes feeding one GPU
+each).  TPU-native loading is different: the whole global batch is laid out
+once on the host, then ``jax.device_put`` with a NamedSharding splits it
+across the mesh's data axes in one call — XLA then streams per-device
+shards over PCIe/DMA.  A one-deep prefetch thread overlaps host batch
+assembly with device compute (HBM is the bottleneck; keep it fed).
+
+When the native C++ shuffle/prefetch ring buffer is built
+(mlcomp_tpu/native), it slots in under this same interface.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from mlcomp_tpu.parallel.mesh import batch_sharding
+
+
+class DataLoader:
+    def __init__(
+        self,
+        data: Dict[str, np.ndarray],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        mesh=None,
+        pad_to_batch: bool = True,
+    ):
+        n = len(next(iter(data.values())))
+        for k, v in data.items():
+            if len(v) != n:
+                raise ValueError(f"array {k!r} length {len(v)} != {n}")
+        self.data = data
+        self.n = n
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.mesh = mesh
+        self.pad_to_batch = pad_to_batch
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+    def _host_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        idx = np.arange(self.n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            rng.shuffle(idx)
+        self._epoch += 1
+        nb = len(self)
+        for b in range(nb):
+            sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
+            batch = {k: v[sel] for k, v in self.data.items()}
+            if self.pad_to_batch and len(sel) < self.batch_size:
+                # static shapes for XLA: pad the ragged tail, mask via 'valid'
+                pad = self.batch_size - len(sel)
+                batch = {
+                    k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                    for k, v in batch.items()
+                }
+                batch["valid"] = np.concatenate(
+                    [np.ones(len(sel), np.float32), np.zeros(pad, np.float32)]
+                )
+            yield batch
+
+    def _place(self, batch: Dict[str, np.ndarray]):
+        if self.mesh is None:
+            return {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        sharding = batch_sharding(self.mesh)
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+    def __iter__(self):
+        """One-deep prefetch: host assembly of batch k+1 overlaps device k."""
+        q: "queue.Queue" = queue.Queue(maxsize=2)
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for b in self._host_batches():
+                    if stop.is_set():
+                        return
+                    q.put(b)
+            finally:
+                q.put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                b = q.get()
+                if b is None:
+                    break
+                yield self._place(b)
+        finally:
+            stop.set()
+            # drain so the producer can observe stop and exit
+            while not q.empty():
+                q.get_nowait()
+            t.join(timeout=5.0)

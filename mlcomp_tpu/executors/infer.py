@@ -1,0 +1,86 @@
+"""Inference and validation executors (the reference's infer/valid stages).
+
+``infer`` restores a train task's checkpoint, forward-passes a dataset on
+the mesh, and writes predictions to model storage.  ``valid`` computes
+metrics against labels and logs them.  Both locate the upstream checkpoint
+either from an explicit ``ckpt_dir`` arg or from the result of the task
+they depend on (the scheduler stores task results in the db).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from mlcomp_tpu.executors.base import ExecutionContext, Executor
+
+
+def _find_ckpt_dir(ctx: ExecutionContext, args: Dict[str, Any]) -> Optional[str]:
+    """Explicit ``ckpt_dir`` arg, else the checkpoint produced by a task
+    this one depends on (NOT just any train task — a grid-expanded DAG has
+    many checkpoints and each downstream task must follow its own edge)."""
+    if args.get("ckpt_dir"):
+        return str(args["ckpt_dir"])
+    if ctx.store is None:
+        return None
+    rows = {r["name"]: r for r in ctx.store.task_rows(ctx.dag_id)}
+    me = rows.get(ctx.task_name)
+    depends = json.loads(me["depends"]) if me else []
+    for name in depends:
+        row = rows.get(name)
+        if row and row["result"]:
+            res = json.loads(row["result"])
+            if isinstance(res, dict) and "ckpt_dir" in res:
+                return res["ckpt_dir"]
+    return None
+
+
+class InferExecutor(Executor):
+    name = "infer"
+
+    def work(self, ctx: ExecutionContext) -> Optional[Dict[str, Any]]:
+        from mlcomp_tpu.io.checkpoint import restore_checkpoint
+        from mlcomp_tpu.train.loop import Trainer
+
+        cfg = dict(self.args)
+        out_path = Path(cfg.pop("out", Path(ctx.workdir) / f"{ctx.task_name}_preds.npz"))
+        trainer = Trainer(cfg)
+        ckpt_dir = _find_ckpt_dir(ctx, cfg)
+        if ckpt_dir:
+            trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
+            ctx.log(f"restored checkpoint from {ckpt_dir}")
+        else:
+            ctx.log("no checkpoint found; inferring with fresh params", level="warning")
+        split = "infer" if "infer" in trainer.loaders else "valid"
+        preds = trainer.predict(split)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        np.savez_compressed(out_path, preds=preds)
+        ctx.log(f"wrote {preds.shape} predictions -> {out_path}")
+        return {"preds": str(out_path), "n": int(preds.shape[0])}
+
+
+class ValidExecutor(Executor):
+    name = "valid"
+
+    def work(self, ctx: ExecutionContext) -> Optional[Dict[str, Any]]:
+        from mlcomp_tpu.io.checkpoint import restore_checkpoint
+        from mlcomp_tpu.train.loop import Trainer
+
+        cfg = dict(self.args)
+        trainer = Trainer(cfg)
+        ckpt_dir = _find_ckpt_dir(ctx, cfg)
+        if ckpt_dir:
+            trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
+            ctx.log(f"restored checkpoint from {ckpt_dir}")
+        else:
+            ctx.log(
+                "no checkpoint found; validating fresh params", level="warning"
+            )
+        stats = trainer.eval_epoch("valid")
+        for k, v in stats.items():
+            ctx.metric(f"valid/{k}", v)
+        ctx.log("valid: " + " ".join(f"{k}={v:.4f}" for k, v in sorted(stats.items())))
+        return {k: float(v) for k, v in stats.items()}

@@ -1,0 +1,4 @@
+from mlcomp_tpu.io.checkpoint import save_checkpoint, restore_checkpoint, latest_step
+from mlcomp_tpu.io.storage import ModelStorage
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step", "ModelStorage"]

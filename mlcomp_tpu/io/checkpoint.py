@@ -1,0 +1,56 @@
+"""Checkpoint save/restore over orbax.
+
+The reference checkpoints torch state_dicts to host disk; here the whole
+TrainState pytree (params, BN stats, optimizer state, step) goes through
+orbax — which handles sharded arrays natively, so the same call works
+single-chip and under a multi-host mesh (each host writes its shards).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _mgr(directory: Path, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+def save_checkpoint(
+    directory: str | Path, state: Any, step: int, max_to_keep: int = 3
+) -> str:
+    """Save a pytree; returns the checkpoint path."""
+    directory = Path(directory).absolute()
+    with _mgr(directory, max_to_keep) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+    return str(directory / str(step))
+
+
+def latest_step(directory: str | Path) -> Optional[int]:
+    directory = Path(directory).absolute()
+    if not directory.exists():
+        return None
+    with _mgr(directory) as mgr:
+        return mgr.latest_step()
+
+
+def restore_checkpoint(
+    directory: str | Path, target: Any, step: Optional[int] = None
+) -> Any:
+    """Restore into the structure of ``target`` (shapes/shardings from it)."""
+    directory = Path(directory).absolute()
+    with _mgr(directory) as mgr:
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+        return mgr.restore(step, args=ocp.args.StandardRestore(target))

@@ -1,0 +1,34 @@
+"""Small convnet for MNIST-class tasks (the reference's MNIST DAG model,
+BASELINE.json:7).  NHWC layout — the TPU-native convolution layout."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+
+
+@MODELS.register("mnist_cnn")
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    features: Sequence[int] = (32, 64)
+    dense: int = 128
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        if x.ndim == 3:  # (B, H, W) -> (B, H, W, 1)
+            x = x[..., None]
+        x = x.astype(dtype)
+        for f in self.features:
+            x = nn.Conv(f, (3, 3), dtype=dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense, dtype=dtype)(x))
+        # final logits in fp32 for a stable softmax/loss
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

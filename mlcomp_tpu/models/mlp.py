@@ -1,0 +1,26 @@
+"""Plain MLP classifier — smallest model in the zoo; test workhorse."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+
+
+@MODELS.register("mlp")
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden: Sequence[int] = (128, 128)
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = x.reshape((x.shape[0], -1)).astype(dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=dtype)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,8 @@
+from mlcomp_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    batch_sharding,
+    replicated,
+)
+
+__all__ = ["MeshSpec", "make_mesh", "batch_sharding", "replicated"]

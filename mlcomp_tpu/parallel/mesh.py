@@ -1,0 +1,102 @@
+"""Device mesh construction and basic shardings.
+
+Where the reference synchronizes gradients with torch.distributed/NCCL
+all-reduce across Docker-pinned GPUs, the TPU-native design is SPMD over a
+``jax.sharding.Mesh``: lay out named axes (dp/fsdp/tp/pp/sp/ep), annotate
+array shardings, and let XLA insert the collectives over ICI
+(BASELINE.json:5 — "gradient sync moves from torch.distributed/NCCL
+all-reduce to lax.psum over ICI").
+
+The mesh axes used throughout the framework:
+
+- ``dp``   — data parallel (batch dimension; gradients all-reduced)
+- ``fsdp`` — fully-sharded data parallel (params sharded over this axis too)
+- ``tp``   — tensor parallel (feature dimensions of big matmuls)
+- ``pp``   — pipeline parallel (layer stages)
+- ``sp``   — sequence/context parallel (ring attention)
+- ``ep``   — expert parallel (MoE experts)
+
+Any subset may be used; axes of size 1 are free.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; -1 on at most one axis means "all remaining"."""
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXES}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices but {n_devices} available"
+            )
+        return sizes
+
+    @staticmethod
+    def from_config(cfg: Optional[Dict[str, int]]) -> "MeshSpec":
+        if not cfg:
+            return MeshSpec()
+        unknown = set(cfg) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXES}")
+        return MeshSpec(**{a: int(v) for a, v in cfg.items()})
+
+
+def make_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with the framework's canonical axis order.
+
+    Axis order puts ``tp`` innermost so tensor-parallel collectives ride
+    the fastest ICI links (nearest-neighbor), and ``dp`` outermost where
+    all-reduce latency matters least — the standard TPU layout recipe.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dimension sharding over every data-like axis (dp×fsdp×...)."""
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,4 @@
+from mlcomp_tpu.train.state import TrainState
+from mlcomp_tpu.train.loop import Trainer
+
+__all__ = ["TrainState", "Trainer"]

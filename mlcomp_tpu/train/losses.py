@@ -1,0 +1,98 @@
+"""Loss function registry.
+
+Catalyst criterions are torch modules picked by config; here a loss is a
+pure function ``(logits, batch) -> scalar`` picked from a registry so the
+YAML surface stays the same shape.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from mlcomp_tpu.utils.registry import Registry
+
+LOSSES: Registry = Registry("losses")
+
+
+def masked_mean(per_example, batch):
+    """Mean over the batch honoring the loader's pad mask (``valid``).
+
+    ``per_example`` has shape (B, ...); non-batch dims are averaged first,
+    then padded rows (valid==0, emitted by DataLoader pad_to_batch for the
+    ragged tail when drop_last=False) are excluded from the mean.
+    """
+    while per_example.ndim > 1:
+        per_example = per_example.mean(axis=-1)
+    m = batch.get("valid") if isinstance(batch, dict) else None
+    if m is None:
+        return per_example.mean()
+    m = m.astype(per_example.dtype)
+    return (per_example * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+@LOSSES.register("cross_entropy")
+def cross_entropy(logits, batch):
+    labels = batch["y"]
+    if labels.ndim == logits.ndim:  # one-hot / soft labels
+        per = optax.softmax_cross_entropy(logits, labels)
+    else:
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return masked_mean(per, batch)
+
+
+@LOSSES.register("smoothed_cross_entropy")
+def smoothed_cross_entropy(logits, batch, smoothing: float = 0.1):
+    labels = batch["y"]
+    n = logits.shape[-1]
+    onehot = jnp.where(
+        jnp.arange(n)[None, :] == labels[..., None], 1.0 - smoothing, smoothing / (n - 1)
+    )
+    return masked_mean(optax.softmax_cross_entropy(logits, onehot), batch)
+
+
+@LOSSES.register("bce_with_logits")
+def bce_with_logits(logits, batch):
+    return masked_mean(optax.sigmoid_binary_cross_entropy(logits, batch["y"]), batch)
+
+
+@LOSSES.register("mse")
+def mse(preds, batch):
+    return masked_mean((preds - batch["y"]) ** 2, batch)
+
+
+@LOSSES.register("pixel_cross_entropy")
+def pixel_cross_entropy(logits, batch):
+    """Per-pixel CE for segmentation: logits (B,H,W,C), labels (B,H,W)."""
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+    return masked_mean(per, batch)
+
+
+@LOSSES.register("dice")
+def dice_loss(logits, batch, eps: float = 1e-6):
+    """Soft dice over one-hot classes; segmentation complement to pixel CE."""
+    import jax
+
+    labels = batch["y"]
+    n = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    onehot = (jnp.arange(n)[None, None, None, :] == labels[..., None]).astype(
+        probs.dtype
+    )
+    inter = jnp.sum(probs * onehot, axis=(1, 2))
+    union = jnp.sum(probs + onehot, axis=(1, 2))
+    return 1.0 - jnp.mean((2 * inter + eps) / (union + eps))
+
+
+def create_loss(cfg):
+    """``"cross_entropy"`` or ``{name: ..., **kwargs}`` → callable."""
+    if isinstance(cfg, str):
+        return LOSSES.get(cfg)
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    fn = LOSSES.get(name)
+    if not cfg:
+        return fn
+    import functools
+
+    return functools.partial(fn, **cfg)

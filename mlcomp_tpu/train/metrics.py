@@ -1,0 +1,61 @@
+"""Metric functions computed inside the jitted step (device-side).
+
+Each metric maps ``(outputs, batch) -> scalar``; the loop averages them
+over an epoch.  Mirrors the reference's Catalyst callback metrics
+(accuracy for classification, IoU/dice for segmentation) as pure JAX.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mlcomp_tpu.utils.registry import Registry
+
+from mlcomp_tpu.train.losses import masked_mean
+
+METRICS: Registry = Registry("metrics")
+
+
+@METRICS.register("accuracy")
+def accuracy(outputs, batch):
+    per = (jnp.argmax(outputs, axis=-1) == batch["y"]).astype(jnp.float32)
+    return masked_mean(per, batch)
+
+
+@METRICS.register("top5_accuracy")
+def top5_accuracy(outputs, batch):
+    k = min(5, outputs.shape[-1])
+    topk = jnp.argsort(outputs, axis=-1)[..., -k:]
+    hit = jnp.any(topk == batch["y"][..., None], axis=-1)
+    return masked_mean(hit.astype(jnp.float32), batch)
+
+
+@METRICS.register("miou")
+def miou(outputs, batch, eps: float = 1e-6):
+    """Mean IoU over classes; outputs (B,H,W,C), labels (B,H,W)."""
+    n = outputs.shape[-1]
+    pred = jnp.argmax(outputs, axis=-1)
+    labels = batch["y"]
+    ious = []
+    for c in range(n):  # n is static — unrolls into vector ops
+        p = pred == c
+        l = labels == c
+        inter = jnp.sum(jnp.logical_and(p, l).astype(jnp.float32))
+        union = jnp.sum(jnp.logical_or(p, l).astype(jnp.float32))
+        ious.append((inter + eps) / (union + eps))
+    return jnp.mean(jnp.stack(ious))
+
+
+@METRICS.register("pixel_accuracy")
+def pixel_accuracy(outputs, batch):
+    per = (jnp.argmax(outputs, axis=-1) == batch["y"]).astype(jnp.float32)
+    return masked_mean(per, batch)
+
+
+@METRICS.register("mae")
+def mae(outputs, batch):
+    return masked_mean(jnp.abs(outputs - batch["y"]), batch)
+
+
+def create_metrics(names):
+    return {n: METRICS.get(n) for n in (names or [])}

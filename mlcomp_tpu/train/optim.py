@@ -1,0 +1,106 @@
+"""Optimizer + LR-schedule factories over optax.
+
+The reference's optimizers come from torch via Catalyst config; the TPU
+equivalents are optax gradient transforms, composed functionally so the
+whole update fuses into the jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import optax
+
+from mlcomp_tpu.utils.registry import Registry
+
+SCHEDULES: Registry = Registry("lr schedules")
+OPTIMIZERS: Registry = Registry("optimizers")
+
+
+@SCHEDULES.register("constant")
+def constant(lr: float, **_):
+    return optax.constant_schedule(lr)
+
+
+@SCHEDULES.register("cosine")
+def cosine(lr: float, decay_steps: int, alpha: float = 0.0, **_):
+    return optax.cosine_decay_schedule(lr, decay_steps, alpha)
+
+
+@SCHEDULES.register("warmup_cosine")
+def warmup_cosine(
+    lr: float, warmup_steps: int, decay_steps: int, end_lr: float = 0.0, **_
+):
+    return optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, decay_steps, end_lr
+    )
+
+
+@SCHEDULES.register("step")
+def step(lr: float, boundaries_and_scales: Dict[int, float], **_):
+    return optax.piecewise_constant_schedule(
+        lr, {int(k): float(v) for k, v in boundaries_and_scales.items()}
+    )
+
+
+@SCHEDULES.register("linear_warmup")
+def linear_warmup(lr: float, warmup_steps: int, **_):
+    return optax.linear_schedule(0.0, lr, warmup_steps)
+
+
+def _sched(lr: Union[float, Dict[str, Any]]):
+    if isinstance(lr, dict):
+        cfg = dict(lr)
+        name = cfg.pop("name", "constant")
+        return SCHEDULES.get(name)(**cfg)
+    return float(lr)
+
+
+@OPTIMIZERS.register("sgd")
+def sgd(lr=0.01, momentum: float = 0.0, nesterov: bool = False, **_):
+    return optax.sgd(_sched(lr), momentum=momentum, nesterov=nesterov)
+
+
+@OPTIMIZERS.register("adam")
+def adam(lr=1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8, **_):
+    return optax.adam(_sched(lr), b1=b1, b2=b2, eps=eps)
+
+
+@OPTIMIZERS.register("adamw")
+def adamw(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, weight_decay=1e-4, **_):
+    return optax.adamw(_sched(lr), b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+
+
+@OPTIMIZERS.register("lamb")
+def lamb(lr=1e-3, weight_decay: float = 0.0, **_):
+    return optax.lamb(_sched(lr), weight_decay=weight_decay)
+
+
+@OPTIMIZERS.register("rmsprop")
+def rmsprop(lr=1e-3, decay: float = 0.9, eps: float = 1e-8, momentum: float = 0.0, **_):
+    return optax.rmsprop(_sched(lr), decay=decay, eps=eps, momentum=momentum)
+
+
+@OPTIMIZERS.register("adafactor")
+def adafactor(lr=None, **kw):
+    return optax.adafactor(learning_rate=_sched(lr) if lr is not None else None, **kw)
+
+
+def create_optimizer(cfg: Union[str, Dict[str, Any]]) -> optax.GradientTransformation:
+    """Build from ``{name: adam, lr: ..., grad_clip: ..., ...}``.
+
+    ``grad_clip`` (global-norm clipping) and ``accum_steps`` (gradient
+    accumulation via optax.MultiSteps) compose around any base optimizer.
+    """
+    if isinstance(cfg, str):
+        cfg = {"name": cfg}
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    grad_clip = cfg.pop("grad_clip", None)
+    accum_steps = int(cfg.pop("accum_steps", 1))
+    tx = OPTIMIZERS.get(name)(**cfg)
+    if grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(float(grad_clip)), tx)
+    if accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)
+    return tx

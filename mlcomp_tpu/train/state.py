@@ -1,0 +1,66 @@
+"""TrainState: the one pytree that flows through the jitted step.
+
+Replaces the reference's torch module + optimizer object state with an
+immutable pytree (params, mutable model state like BN statistics, optimizer
+state, step counter) — required for functional transforms and for orbax
+checkpointing to see the whole training state as one tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    model_state: Any                        # e.g. {'batch_stats': ...}; {} if none
+    opt_state: Any
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, apply_fn, params, tx, model_state=None) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            model_state=model_state or {},
+            opt_state=tx.init(params),
+            tx=tx,
+            apply_fn=apply_fn,
+        )
+
+    def apply_gradients(self, grads, new_model_state=None) -> "TrainState":
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            model_state=(
+                new_model_state if new_model_state is not None else self.model_state
+            ),
+            opt_state=new_opt,
+        )
+
+    @property
+    def variables(self) -> Dict[str, Any]:
+        """Full variable dict for model.apply."""
+        return {"params": self.params, **self.model_state}
+
+
+def init_model(model, sample_batch, rng: Optional[jax.Array] = None):
+    """Initialize a flax module; returns (params, model_state)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # dict() so .pop has plain-dict semantics even if flax returns FrozenDict
+    variables = dict(model.init(rng, sample_batch["x"], train=False))
+    params = variables.pop("params", {})
+    return params, variables
+
+
+def param_count(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -21,6 +21,14 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import pytest  # noqa: E402
 
+# The TPU-VM image's sitecustomize force-registers the axon TPU plugin and
+# sets jax_platforms="axon,cpu" *in-process*, overriding the env var — so any
+# backend query would first try to init the TPU tunnel (slow, can stall).
+# Re-pin the config to cpu before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture()
 def tmp_db(tmp_path):
