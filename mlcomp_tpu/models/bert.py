@@ -1,0 +1,113 @@
+"""BERT-style bidirectional encoder for finetuning (BASELINE.json:10 —
+"BERT-base finetune DAG (text executor, non-conv allreduce)").
+
+Ground-up flax implementation shaped for TPU:
+
+- attention runs through ops.attention.dot_product_attention, which
+  dispatches to the Pallas flash-attention kernel on TPU and a fused XLA
+  path elsewhere;
+- bfloat16 activations, fp32 layernorm params and logits;
+- hidden sizes are MXU-tile aligned at base config (768 = 6×128).
+
+Covers both sequence classification (finetune) and masked-LM heads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+from mlcomp_tpu.ops.attention import dot_product_attention
+
+
+class TransformerLayer(nn.Module):
+    hidden: int
+    heads: int
+    mlp_dim: int
+    dtype: jnp.dtype
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        d_head = self.hidden // self.heads
+        q = nn.DenseGeneral((self.heads, d_head), dtype=self.dtype, name="q")(h)
+        k = nn.DenseGeneral((self.heads, d_head), dtype=self.dtype, name="k")(h)
+        v = nn.DenseGeneral((self.heads, d_head), dtype=self.dtype, name="v")(h)
+        attn = dot_product_attention(q, k, v, mask=mask)
+        attn = nn.DenseGeneral(
+            self.hidden, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(attn)
+        if self.dropout > 0:
+            attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
+        x = x + attn
+
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden, dtype=self.dtype)(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+@MODELS.register("bert")
+class Bert(nn.Module):
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    num_classes: Optional[int] = 2   # None -> masked-LM head over vocab
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        ids = x.astype(jnp.int32)
+        # padding mask from token id 0
+        pad = (ids != 0).astype(dtype)
+        mask = pad[:, None, None, :]  # (B, 1, 1, S) broadcast over heads/query
+
+        tok = nn.Embed(self.vocab_size, self.hidden, dtype=dtype, name="tok_emb")(ids)
+        pos = self.param(
+            "pos_emb",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.hidden),
+            jnp.float32,
+        )
+        h = tok + pos[None, : ids.shape[1], :].astype(dtype)
+        h = nn.LayerNorm(dtype=dtype, param_dtype=jnp.float32)(h)
+
+        for _ in range(self.layers):
+            h = TransformerLayer(
+                self.hidden, self.heads, self.mlp_dim, dtype, self.dropout
+            )(h, mask=mask, train=train)
+        h = nn.LayerNorm(dtype=dtype, param_dtype=jnp.float32)(h)
+
+        if self.num_classes is None:
+            # masked-LM: tied-ish output over vocab (untied dense head here)
+            return nn.Dense(self.vocab_size, dtype=jnp.float32, name="mlm_head")(h)
+        # classification: CLS pooling (position 0)
+        cls = h[:, 0, :]
+        cls = jnp.tanh(nn.Dense(self.hidden, dtype=dtype, name="pooler")(cls))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="cls_head")(cls)
+
+
+@MODELS.register("bert_base")
+def bert_base(**kw) -> Bert:
+    return Bert(**kw)
+
+
+@MODELS.register("bert_small")
+def bert_small(**kw) -> Bert:
+    kw.setdefault("hidden", 256)
+    kw.setdefault("layers", 4)
+    kw.setdefault("heads", 4)
+    kw.setdefault("mlp_dim", 1024)
+    return Bert(**kw)

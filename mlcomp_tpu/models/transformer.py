@@ -1,0 +1,108 @@
+"""Decoder-only Transformer LM — the framework's long-context flagship.
+
+Not present in the upstream reference's model zoo (it predates LLMs); this
+is the model family the long-context/distributed machinery (ring attention
+over the ``sp`` axis, tensor parallel over ``tp``, pipeline over ``pp``,
+MoE over ``ep``) is exercised on, per the build brief's "long-context and
+distributed are first-class".
+
+TPU-first: RoPE positions, pre-norm, bfloat16 activations / fp32 residual-
+critical params, fused attention via ops.attention, MXU-aligned widths.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+from mlcomp_tpu.ops.attention import dot_product_attention
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary embeddings; x: (B, S, H, D), positions: (B, S)."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freq  # (B, S, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        return (x32 * scale).astype(self.dtype)
+
+
+class DecoderLayer(nn.Module):
+    hidden: int
+    heads: int
+    kv_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, positions):
+        d_head = self.hidden // self.heads
+        h = RMSNorm(self.dtype)(x)
+        q = nn.DenseGeneral((self.heads, d_head), use_bias=False, dtype=self.dtype, name="q")(h)
+        k = nn.DenseGeneral((self.kv_heads, d_head), use_bias=False, dtype=self.dtype, name="k")(h)
+        v = nn.DenseGeneral((self.kv_heads, d_head), use_bias=False, dtype=self.dtype, name="v")(h)
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+        if self.kv_heads != self.heads:  # grouped-query attention
+            rep = self.heads // self.kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = dot_product_attention(q, k, v, causal=True)
+        x = x + nn.DenseGeneral(
+            self.hidden, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="out"
+        )(attn)
+
+        h = RMSNorm(self.dtype)(x)
+        gate = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype, name="gate")(h)
+        up = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype, name="up")(h)
+        h = nn.silu(gate) * up
+        return x + nn.Dense(self.hidden, use_bias=False, dtype=self.dtype, name="down")(h)
+
+
+@MODELS.register("transformer_lm")
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    hidden: int = 512
+    layers: int = 8
+    heads: int = 8
+    kv_heads: Optional[int] = None
+    mlp_dim: Optional[int] = None
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        ids = x.astype(jnp.int32)
+        b, s = ids.shape
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        kv_heads = self.kv_heads or self.heads
+        mlp_dim = self.mlp_dim or self.hidden * 4
+
+        h = nn.Embed(self.vocab_size, self.hidden, dtype=dtype, name="emb")(ids)
+        for _ in range(self.layers):
+            h = DecoderLayer(self.hidden, self.heads, kv_heads, mlp_dim, dtype)(
+                h, positions
+            )
+        h = RMSNorm(dtype)(h)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(h)

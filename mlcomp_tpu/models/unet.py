@@ -1,0 +1,70 @@
+"""U-Net semantic segmentation (BASELINE.json:9 — "U-Net
+semantic-segmentation DAG").
+
+TPU-first choices: NHWC; bfloat16 activations / fp32 logits; resize-conv
+upsampling (nn.ConvTranspose lowers to a strided conv either way on XLA,
+but resize+conv avoids checkerboard artifacts and fuses cleanly); feature
+widths doubled per level from a 128-aligned base.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+
+
+class ConvBlock(nn.Module):
+    features: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for _ in range(2):
+            x = nn.Conv(self.features, (3, 3), use_bias=False, dtype=self.dtype)(x)
+            x = nn.GroupNorm(
+                num_groups=min(32, self.features), dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )(x)
+            x = nn.relu(x)
+        return x
+
+
+@MODELS.register("unet")
+class UNet(nn.Module):
+    num_classes: int = 4
+    features: Sequence[int] = (32, 64, 128, 256)
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        levels = len(self.features) - 1
+        div = 2**levels
+        if x.shape[1] % div or x.shape[2] % div:
+            raise ValueError(
+                f"UNet with {levels} down levels needs H,W divisible by {div}; "
+                f"got {x.shape[1]}x{x.shape[2]} — pad the input or reduce features"
+            )
+        x = x.astype(dtype)
+
+        skips = []
+        for f in self.features[:-1]:
+            x = ConvBlock(f, dtype)(x, train)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+
+        x = ConvBlock(self.features[-1], dtype)(x, train)  # bottleneck
+
+        for f, skip in zip(reversed(self.features[:-1]), reversed(skips)):
+            b, h, w, c = x.shape
+            x = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+            x = nn.Conv(f, (2, 2), dtype=dtype)(x)
+            x = jnp.concatenate([skip.astype(dtype), x], axis=-1)
+            x = ConvBlock(f, dtype)(x, train)
+
+        return nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32, name="head")(x)

@@ -1,0 +1,83 @@
+"""Attention dispatch: one call site, multiple backends.
+
+Models call ``dot_product_attention``; this module picks the fastest
+available implementation:
+
+- on TPU, the Pallas flash-attention kernel (ops/pallas/flash_attention.py)
+  — blocked online-softmax, O(S) memory, MXU-tiled;
+- elsewhere (CPU tests, interpret mode), a reference XLA einsum path that
+  XLA fuses well enough for correctness work.
+
+The reference framework has no custom attention (torch SDPA inside
+Catalyst models); this dispatch is where the TPU build spends its kernel
+budget instead.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """XLA path. q,k,v: (B, S, H, D); mask broadcastable to (B, H, Sq, Sk)."""
+    *_, s_q, h, d = (*q.shape,)
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    # fp32 softmax accumulation regardless of activation dtype
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_k = k.shape[1]
+        cm = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        logits = jnp.where(cm[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask.astype(jnp.bool_), logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Multi-head attention over (B, S, H, D) tensors.
+
+    ``mask``: True = attend, broadcastable to (B, H, Sq, Sk).
+    ``causal``: apply a causal triangle (decoder LM).
+    """
+    use_flash = os.environ.get("MLCOMP_TPU_FLASH", "auto")
+    if use_flash != "0" and (use_flash == "1" or _on_tpu()):
+        try:
+            from mlcomp_tpu.ops.pallas.flash_attention import flash_attention
+
+            if mask is None:  # kernel supports causal/full; arbitrary masks
+                return flash_attention(q, k, v, causal=causal, scale=scale)
+        except (ImportError, NotImplementedError) as e:
+            if use_flash == "1":  # explicit request must not fail silently
+                warnings.warn(
+                    f"MLCOMP_TPU_FLASH=1 but flash attention unavailable "
+                    f"({type(e).__name__}: {e}); using reference path",
+                    stacklevel=2,
+                )
+    return reference_attention(q, k, v, mask=mask, causal=causal, scale=scale)

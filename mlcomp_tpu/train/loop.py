@@ -33,18 +33,36 @@ from mlcomp_tpu.train.optim import create_optimizer
 from mlcomp_tpu.train.state import TrainState, init_model, param_count
 
 
-def make_train_step(loss_fn, metric_fns: Dict[str, Callable], has_model_state: bool):
-    """Build the pure train step; jitted once, reused every step."""
+def make_train_step(
+    loss_fn,
+    metric_fns: Dict[str, Callable],
+    has_model_state: bool,
+    rng_key: Optional[jax.Array] = None,
+):
+    """Build the pure train step; jitted once, reused every step.
+
+    ``rng_key`` seeds per-step rngs (dropout etc.), folded with the step
+    counter so every step draws fresh randomness deterministically.
+    """
+    base_key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
 
     def train_step(state: TrainState, batch):
+        step_rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
+
         def loss_of(params):
             variables = {"params": params, **state.model_state}
             if has_model_state:
                 outputs, new_model_state = state.apply_fn(
-                    variables, batch["x"], train=True, mutable=list(state.model_state)
+                    variables,
+                    batch["x"],
+                    train=True,
+                    mutable=list(state.model_state),
+                    rngs=step_rngs,
                 )
             else:
-                outputs = state.apply_fn(variables, batch["x"], train=True)
+                outputs = state.apply_fn(
+                    variables, batch["x"], train=True, rngs=step_rngs
+                )
                 new_model_state = state.model_state
             loss = loss_fn(outputs, batch)
             return loss, (outputs, new_model_state)
@@ -124,7 +142,12 @@ class Trainer:
         self.has_model_state = bool(model_state)
 
         self._train_step = jax.jit(
-            make_train_step(self.loss_fn, self.metric_fns, self.has_model_state),
+            make_train_step(
+                self.loss_fn,
+                self.metric_fns,
+                self.has_model_state,
+                rng_key=jax.random.PRNGKey(self.seed + 1),
+            ),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(make_eval_step(self.loss_fn, self.metric_fns))
