@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 train-step throughput, images/sec/chip.
+
+Mirrors the reference's north-star metric (BASELINE.json:2 — "images/sec/chip
+on a ResNet-50 DAG").  The acceptance bar is >=90% of 8xA100 DDP per-chip
+step throughput (BASELINE.json:5); no published number exists for the
+reference ("published": {}), so the baseline constant below is the
+well-known public figure for ResNet-50 DDP on A100 with AMP + channels-last
+(~2.5k images/sec per GPU).  vs_baseline = ours / that.
+
+Method: synthetic ImageNet-shaped batch resident in HBM (the metric is the
+step, not host IO), full train step = forward + backward + SGD-momentum
+update, bfloat16 activations / fp32 params, jitted with donated state.
+Prints ONE JSON line.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# A100 80GB, ResNet-50 v1.5 DDP, AMP, per-GPU throughput (public MLPerf-class
+# number); the reference's own repo publishes nothing (BASELINE.md).
+A100_DDP_PER_CHIP = 2500.0
+
+BATCH = int(os.environ.get("MLCOMP_BENCH_BATCH", "256"))
+IMAGE = int(os.environ.get("MLCOMP_BENCH_IMAGE", "224"))
+WARMUP = int(os.environ.get("MLCOMP_BENCH_WARMUP", "5"))
+STEPS = int(os.environ.get("MLCOMP_BENCH_STEPS", "30"))
+
+
+def main() -> None:
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh, replicated, batch_sharding
+    from mlcomp_tpu.train.loop import make_train_step
+    from mlcomp_tpu.train.losses import create_loss
+    from mlcomp_tpu.train.optim import create_optimizer
+    from mlcomp_tpu.train.state import TrainState, init_model
+
+    n_chips = jax.device_count()
+    mesh = make_mesh(MeshSpec(dp=n_chips))
+
+    model = create_model({"name": "resnet50", "num_classes": 1000})
+    rng = jax.random.PRNGKey(0)
+    x_host = np.random.RandomState(0).rand(BATCH, IMAGE, IMAGE, 3).astype(np.float32)
+    y_host = np.random.RandomState(1).randint(0, 1000, size=(BATCH,))
+
+    params, model_state = init_model(model, {"x": jnp.zeros((1, IMAGE, IMAGE, 3))}, rng)
+    tx = create_optimizer({"name": "sgd", "lr": 0.1, "momentum": 0.9})
+    state = TrainState.create(model.apply, params, tx, model_state)
+    state = jax.device_put(state, replicated(mesh))
+
+    batch = {
+        "x": jax.device_put(x_host, batch_sharding(mesh)),
+        "y": jax.device_put(y_host, batch_sharding(mesh)),
+    }
+
+    loss_fn = create_loss("cross_entropy")
+    step = jax.jit(
+        make_train_step(loss_fn, {}, has_model_state=bool(model_state)),
+        donate_argnums=(0,),
+    )
+
+    for _ in range(WARMUP):
+        state, stats = step(state, batch)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, stats = step(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = BATCH * STEPS / dt
+    per_chip = images_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / A100_DDP_PER_CHIP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
