@@ -60,7 +60,7 @@ def main() -> None:
 
     loss_fn = create_loss("cross_entropy")
     step = jax.jit(
-        make_train_step(loss_fn, {}, has_model_state=bool(model_state)),
+        make_train_step(loss_fn, {}),
         donate_argnums=(0,),
     )
 

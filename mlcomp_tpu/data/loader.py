@@ -13,6 +13,7 @@ When the native C++ shuffle/prefetch ring buffer is built
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Dict, Iterator, Optional
@@ -54,15 +55,28 @@ class DataLoader:
         return (self.n + self.batch_size - 1) // self.batch_size
 
     def _host_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        from mlcomp_tpu import native
+
         idx = np.arange(self.n)
         if self.shuffle:
-            rng = np.random.RandomState(self.seed + self._epoch)
-            rng.shuffle(idx)
+            # index permutation: numpy RNG by default (reproducible across
+            # installs); native Fisher–Yates when explicitly opted in
+            nidx = None
+            if os.environ.get("MLCOMP_TPU_NATIVE_SHUFFLE"):
+                nidx = native.shuffled_indices(self.n, self.seed + self._epoch)
+            if nidx is not None:
+                idx = nidx
+            else:
+                np.random.RandomState(self.seed + self._epoch).shuffle(idx)
         self._epoch += 1
         nb = len(self)
         for b in range(nb):
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
-            batch = {k: v[sel] for k, v in self.data.items()}
+            # gather on the C++ thread pool (GIL-free memcpy); numpy fallback
+            batch = {}
+            for k, v in self.data.items():
+                g = native.gather_rows(v, sel)
+                batch[k] = g if g is not None else v[sel]
             if self.pad_to_batch and len(sel) < self.batch_size:
                 # static shapes for XLA: pad the ragged tail, mask via 'valid'
                 pad = self.batch_size - len(sel)

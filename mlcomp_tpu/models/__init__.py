@@ -19,7 +19,7 @@ def load_all() -> None:
 
     import importlib
 
-    for mod in ("resnet", "unet", "bert", "transformer"):
+    for mod in ("resnet", "unet", "bert", "transformer", "moe"):
         name = f"mlcomp_tpu.models.{mod}"
         try:
             importlib.import_module(name)

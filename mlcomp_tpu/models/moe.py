@@ -1,0 +1,191 @@
+"""Mixture-of-Experts transformer LM — expert parallelism over ``ep``.
+
+No MoE exists in the reference (its model surface is torchvision-era);
+this family exists to make the ``ep`` mesh axis a real, exercised
+capability. TPU-first design choices:
+
+- Switch/Mesh-TF style STATIC dispatch: top-k routing materialized as
+  dense one-hot dispatch/combine tensors and einsums — fixed shapes, no
+  sorts or gathers, so XLA tiles everything onto the MXU and inserts the
+  token all-to-all implicitly when expert weights are sharded over ep;
+- stacked expert weights ``experts_w1: (E, d, f)`` / ``experts_w2:
+  (E, f, d)`` shard over ``ep`` (and ``f`` over ``tp``) via
+  parallel/sharding.py rules;
+- capacity-factor token dropping (overflow tokens pass through the
+  residual untouched) keeps shapes static under any routing skew;
+- router in fp32 (routing decisions are precision-sensitive), experts in
+  the model dtype;
+- the load-balance auxiliary loss is ``sow``-ed into the ``losses``
+  collection; the train step adds every sown loss to the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+from mlcomp_tpu.models.transformer import DecoderLayer, RMSNorm
+
+
+class MoEBlock(nn.Module):
+    """Top-k routed expert FFN over flattened (tokens, d) activations."""
+
+    n_experts: int
+    d_model: int
+    d_ff: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+    aux_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, d = x.shape
+        t = b * s
+        e = self.n_experts
+        cap = max(1, int(self.capacity_factor * t * self.k / e))
+        tokens = x.reshape(t, d)
+
+        # fp32 router — tiny matmul, decision quality matters
+        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)                      # (T, E)
+
+        # top-k dispatch with per-expert positions under a fixed capacity:
+        # round r assigns every token its r-th-best expert; a token's slot is
+        # (# earlier tokens routed to that expert, across all rounds so far)
+        combine = jnp.zeros((t, e, cap), jnp.float32)
+        remaining = probs
+        filled = jnp.zeros((e,), jnp.float32)   # slots used per expert
+        for _ in range(self.k):
+            idx = jnp.argmax(remaining, axis=-1)                     # (T,)
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)       # (T, E)
+            gate = (remaining * onehot).sum(-1)                      # (T,)
+            pos = jnp.cumsum(onehot, axis=0) - onehot + filled[None] # (T, E)
+            pos_tok = (pos * onehot).sum(-1).astype(jnp.int32)       # (T,)
+            fits = (pos_tok < cap).astype(jnp.float32)
+            keep = fits * gate
+            combine = combine + (
+                onehot[:, :, None]
+                * jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)[:, None, :]
+                * keep[:, None, None]
+            )
+            # only KEPT tokens occupy slots; dropped ones must not eat
+            # capacity from later rounds
+            filled = filled + (onehot * fits[:, None]).sum(axis=0)
+            remaining = remaining * (1.0 - onehot)
+
+        # GShard-style gate renormalization over the experts that kept the
+        # token; fully-dropped tokens contribute 0 (residual passthrough)
+        denom = combine.sum(axis=(1, 2), keepdims=True)
+        combine = jnp.where(denom > 0.0, combine / jnp.maximum(denom, 1e-9), 0.0)
+        dispatch = (combine > 0.0).astype(self.dtype)                # (T, E, C)
+
+        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * p_e
+        me = probs.mean(axis=0)                                      # (E,)
+        ce = dispatch.sum(axis=(0, 2)) / jnp.maximum(dispatch.sum(), 1.0)
+        aux = self.aux_weight * e * jnp.sum(me * ce)
+        self.sow("losses", "moe_aux", aux)
+
+        w1 = self.param(
+            "experts_w1",
+            nn.initializers.normal(0.02),
+            (e, d, self.d_ff),
+            jnp.float32,
+        ).astype(self.dtype)
+        w2 = self.param(
+            "experts_w2",
+            nn.initializers.normal(0.02),
+            (e, self.d_ff, d),
+            jnp.float32,
+        ).astype(self.dtype)
+
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(self.dtype))
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
+        out = jnp.einsum(
+            "tec,ecd->td", combine.astype(self.dtype), expert_out
+        )
+        return out.reshape(b, s, d)
+
+
+class MoELayer(nn.Module):
+    """Decoder layer whose FFN is a routed MoE block."""
+
+    hidden: int
+    heads: int
+    kv_heads: int
+    n_experts: int
+    d_ff: int
+    k: int
+    capacity_factor: float
+    dtype: jnp.dtype
+    seq_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool = False):
+        from mlcomp_tpu.models.transformer import SelfAttention
+
+        x = SelfAttention(
+            self.hidden, self.heads, self.kv_heads, self.dtype,
+            seq_parallel=self.seq_parallel, name="attn",
+        )(x, positions)
+        h = RMSNorm(self.dtype)(x)
+        return x + MoEBlock(
+            n_experts=self.n_experts,
+            d_model=self.hidden,
+            d_ff=self.d_ff,
+            k=self.k,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+            name="moe",
+        )(h, train=train)
+
+
+@MODELS.register("moe_lm")
+class MoELM(nn.Module):
+    """Decoder LM with MoE FFN every ``moe_every`` layers."""
+
+    vocab_size: int = 32000
+    hidden: int = 512
+    layers: int = 8
+    heads: int = 8
+    kv_heads: Optional[int] = None
+    n_experts: int = 8
+    d_ff: Optional[int] = None
+    k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2
+    dtype: str = "bfloat16"
+    seq_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        ids = x.astype(jnp.int32)
+        b, s = ids.shape
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        kv_heads = self.kv_heads or self.heads
+        d_ff = self.d_ff or self.hidden * 4
+
+        h = nn.Embed(self.vocab_size, self.hidden, dtype=dtype, name="emb")(ids)
+        for i in range(self.layers):
+            if (i + 1) % self.moe_every == 0:
+                h = MoELayer(
+                    self.hidden, self.heads, kv_heads, self.n_experts, d_ff,
+                    self.k, self.capacity_factor, dtype,
+                    seq_parallel=self.seq_parallel,
+                )(h, positions, train=train)
+            else:
+                h = DecoderLayer(
+                    self.hidden, self.heads, kv_heads, d_ff, dtype,
+                    seq_parallel=self.seq_parallel,
+                )(h, positions)
+        h = RMSNorm(dtype)(h)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(h)

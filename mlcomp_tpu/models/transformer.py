@@ -48,12 +48,20 @@ class RMSNorm(nn.Module):
         return (x32 * scale).astype(self.dtype)
 
 
-class DecoderLayer(nn.Module):
+class SelfAttention(nn.Module):
+    """Pre-norm causal self-attention shared by every decoder variant.
+
+    One module so the routing policy (XLA/flash dispatch vs ring attention
+    over the ``sp`` axis) lives in exactly one place.
+    """
+
     hidden: int
     heads: int
     kv_heads: int
-    mlp_dim: int
     dtype: jnp.dtype
+    # route attention through ring attention when the current mesh has an
+    # sp axis > 1 (sequence/context parallelism for long sequences)
+    seq_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -64,15 +72,37 @@ class DecoderLayer(nn.Module):
         v = nn.DenseGeneral((self.kv_heads, d_head), use_bias=False, dtype=self.dtype, name="v")(h)
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
-        if self.kv_heads != self.heads:  # grouped-query attention
-            rep = self.heads // self.kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = dot_product_attention(q, k, v, causal=True)
-        x = x + nn.DenseGeneral(
+        # GQA: shared KV heads are broadcast inside the attention op, never
+        # materialized rep× in HBM
+        attn = None
+        if self.seq_parallel:
+            from mlcomp_tpu.parallel.mesh import axis_size, current_mesh
+            from mlcomp_tpu.parallel.ring import ring_attention_sharded
+
+            mesh = current_mesh()
+            if axis_size(mesh, "sp") > 1:
+                attn = ring_attention_sharded(q, k, v, mesh, causal=True)
+        if attn is None:
+            attn = dot_product_attention(q, k, v, causal=True)
+        return x + nn.DenseGeneral(
             self.hidden, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="out"
         )(attn)
 
+
+class DecoderLayer(nn.Module):
+    hidden: int
+    heads: int
+    kv_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype
+    seq_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = SelfAttention(
+            self.hidden, self.heads, self.kv_heads, self.dtype,
+            seq_parallel=self.seq_parallel, name="attn",
+        )(x, positions)
         h = RMSNorm(self.dtype)(x)
         gate = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype, name="gate")(h)
         up = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype, name="up")(h)
@@ -89,6 +119,7 @@ class TransformerLM(nn.Module):
     kv_heads: Optional[int] = None
     mlp_dim: Optional[int] = None
     dtype: str = "bfloat16"
+    seq_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -101,8 +132,9 @@ class TransformerLM(nn.Module):
 
         h = nn.Embed(self.vocab_size, self.hidden, dtype=dtype, name="emb")(ids)
         for _ in range(self.layers):
-            h = DecoderLayer(self.hidden, self.heads, kv_heads, mlp_dim, dtype)(
-                h, positions
-            )
+            h = DecoderLayer(
+                self.hidden, self.heads, kv_heads, mlp_dim, dtype,
+                seq_parallel=self.seq_parallel,
+            )(h, positions)
         h = RMSNorm(dtype)(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(h)

@@ -1,0 +1,99 @@
+"""ctypes bindings for the native data-ops library (C++, GIL-free).
+
+Builds ``libmlcdata.so`` from ``dataops.cpp`` on first import (g++ is in
+the image; compile output is cached next to the source and rebuilt only
+when the source is newer). Every entry point degrades gracefully: if the
+toolchain or the build is unavailable, ``lib()`` returns None and callers
+(data/loader.py) fall back to the numpy path — same results, fewer
+cores.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "dataops.cpp"
+_SO = _DIR / "libmlcdata.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+        str(_SRC), "-o", str(_SO),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MLCOMP_TPU_NO_NATIVE"):
+            return None
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            l = ctypes.CDLL(str(_SO))
+        except OSError:
+            return None
+        l.mlc_gather.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+        ]
+        l.mlc_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+        l.mlc_iota.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        _lib = l
+        return _lib
+
+
+def gather_rows(
+    src: np.ndarray, idx: np.ndarray, n_threads: Optional[int] = None
+) -> Optional[np.ndarray]:
+    """dst[i] = src[idx[i]] via the native thread pool; None → caller
+    falls back to numpy. src must be C-contiguous."""
+    l = lib()
+    if l is None or not src.flags.c_contiguous or src.ndim < 1:
+        return None
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    dst = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    l.mlc_gather(
+        src.ctypes.data, row_bytes, idx.ctypes.data, len(idx),
+        dst.ctypes.data, n_threads,
+    )
+    return dst
+
+
+def shuffled_indices(n: int, seed: int) -> Optional[np.ndarray]:
+    """Deterministic native Fisher–Yates permutation of arange(n)."""
+    l = lib()
+    if l is None:
+        return None
+    idx = np.empty(n, dtype=np.int64)
+    l.mlc_iota(idx.ctypes.data, n)
+    l.mlc_shuffle(idx.ctypes.data, n, np.uint64(seed & (2**64 - 1)))
+    return idx

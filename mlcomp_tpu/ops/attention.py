@@ -38,19 +38,36 @@ def reference_attention(
     causal: bool = False,
     scale: Optional[float] = None,
 ) -> jax.Array:
-    """XLA path. q,k,v: (B, S, H, D); mask broadcastable to (B, H, Sq, Sk)."""
-    *_, s_q, h, d = (*q.shape,)
+    """XLA path. q: (B, Sq, H, D); k,v: (B, Sk, Hkv, D) with Hkv | H (GQA —
+    shared KV heads are broadcast, never materialized); mask broadcastable
+    to (B, {1|Hkv}, Sq, Sk) (or (B, H, Sq, Sk) when Hkv == H)."""
+    b, s_q, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    rep = h // h_kv
     scale = scale if scale is not None else 1.0 / (d**0.5)
+    qg = q.reshape(b, s_q, h_kv, rep, d)
     # fp32 softmax accumulation regardless of activation dtype
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32) * scale
     if causal:
         s_k = k.shape[1]
         cm = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
-        logits = jnp.where(cm[None, None], logits, -1e30)
+        logits = jnp.where(cm[None, None, None], logits, -1e30)
     if mask is not None:
-        logits = jnp.where(mask.astype(jnp.bool_), logits, -1e30)
+        m = mask.astype(jnp.bool_)
+        if m.ndim == 4:
+            if m.shape[1] == h and rep > 1:
+                # per-q-head mask: materialize broadcast dims, then split
+                # the head axis into (kv_head, rep) groups
+                m = jnp.broadcast_to(m, (b, h, *m.shape[2:]))
+                m = m.reshape(b, h_kv, rep, *m.shape[2:])
+            else:
+                m = m[:, :, None]  # (B, {1|Hkv}, 1, Sq, Sk)
+        logits = jnp.where(m, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", weights, v)
+    return out.reshape(b, s_q, h, d)
 
 
 def dot_product_attention(
@@ -66,18 +83,30 @@ def dot_product_attention(
     ``mask``: True = attend, broadcastable to (B, H, Sq, Sk).
     ``causal``: apply a causal triangle (decoder LM).
     """
-    use_flash = os.environ.get("MLCOMP_TPU_FLASH", "auto")
-    if use_flash != "0" and (use_flash == "1" or _on_tpu()):
-        try:
-            from mlcomp_tpu.ops.pallas.flash_attention import flash_attention
-
-            if mask is None:  # kernel supports causal/full; arbitrary masks
-                return flash_attention(q, k, v, causal=causal, scale=scale)
-        except (ImportError, NotImplementedError) as e:
-            if use_flash == "1":  # explicit request must not fail silently
+    raw = os.environ.get("MLCOMP_TPU_FLASH", "auto").strip().lower()
+    forced = raw in ("1", "true", "on", "yes")
+    disabled = raw in ("0", "false", "off", "no")
+    if not disabled and (forced or _on_tpu()):
+        if mask is not None:
+            # the kernel covers causal/full; arbitrary dense masks stay on
+            # the XLA path (key-padding masks: see flash_attention kv_len)
+            if forced:
                 warnings.warn(
-                    f"MLCOMP_TPU_FLASH=1 but flash attention unavailable "
-                    f"({type(e).__name__}: {e}); using reference path",
+                    "MLCOMP_TPU_FLASH forced on but a dense mask was passed; "
+                    "using reference path",
                     stacklevel=2,
                 )
+        else:
+            try:
+                from mlcomp_tpu.ops.pallas.flash_attention import flash_attention
+
+                return flash_attention(q, k, v, causal=causal, scale=scale)
+            except (ImportError, NotImplementedError) as e:
+                if forced:  # explicit request must not fail silently
+                    warnings.warn(
+                        f"MLCOMP_TPU_FLASH forced on but flash attention "
+                        f"unavailable ({type(e).__name__}: {e}); using "
+                        f"reference path",
+                        stacklevel=2,
+                    )
     return reference_attention(q, k, v, mask=mask, causal=causal, scale=scale)

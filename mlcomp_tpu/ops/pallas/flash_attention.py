@@ -1,0 +1,349 @@
+"""Pallas TPU flash attention: blocked online-softmax, O(S) memory.
+
+The reference framework has no custom attention kernels (torch SDPA inside
+Catalyst models); this is where the TPU build spends its kernel budget.
+Design follows the canonical TPU flash recipe:
+
+- layout (B, H, S, D) inside the kernel (transposed from the framework's
+  (B, S, H, D) at the wrapper), head_dim zero-padded to a lane multiple
+  (128) — zero pads change nothing: q/k pads contribute 0 to logits, v/dO
+  pads only produce discarded output columns;
+- grid (B, H, num_q_blocks, num_kv_blocks), KV innermost: TPU grids run
+  sequentially, so VMEM scratch (acc, running max m, running sum l)
+  carries across KV steps; init at j == 0, finalize at j == nk - 1;
+- fp32 accumulation; probabilities cast back to the input dtype (bf16)
+  for the MXU matmuls;
+- causal blocks fully above the diagonal are skipped via ``pl.when``;
+  diagonal blocks are masked with ``broadcasted_iota``;
+- GQA: KV-head index maps as ``h // rep`` — shared KV heads are read,
+  never replicated in HBM;
+- backward = custom VJP with two kernels (dq over KV blocks; dk/dv over
+  Q blocks with the GQA group folded into the sequential grid axis),
+  recomputing p from the saved logsumexp instead of storing S×S weights.
+
+Falls back (NotImplementedError → dispatch in ops/attention.py catches)
+when sequence lengths aren't tileable (S < 128 or S_kv % 128 != 0).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _pick_block(s: int, preferred: int = 512) -> int:
+    for b in (preferred, 256, 128):
+        if s % b == 0:
+            return b
+    raise NotImplementedError(f"sequence length {s} not a multiple of 128")
+
+
+def _dot(a, b, trans_b: bool = False):
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
+def _causal_mask(s, i, j, block_q, block_kv):
+    rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale, causal, block_q, block_kv
+):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip KV blocks entirely above the diagonal
+    live = (not causal) or (j * block_kv <= i * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = _dot(q, k, trans_b=True) * scale          # (BQ, BKV) fp32
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_kv)
+        m_prev = m_ref[:, :1]                          # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)                        # (BQ, BKV)
+        l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + _dot(p.astype(v_ref.dtype), v_ref[0, 0])
+        m_ref[:] = jnp.broadcast_to(m_next, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(l_safe[:, 0])).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
+    """q: (B, H, Sq, Dp); k/v: (B, Hkv, Sk, Dp). Returns (out, lse)."""
+    b, h, s_q, d = q.shape
+    h_kv, s_k = k.shape[1], k.shape[2]
+    rep = h // h_kv
+    nq, nk = s_q // block_q, s_k // block_kv
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale, causal, block_q, block_kv
+):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (not causal) or (j * block_kv <= i * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = _dot(q, k, trans_b=True) * scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_kv)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])                    # (BQ, BKV)
+        dp = _dot(do_ref[0, 0], v_ref[0, 0], trans_b=True)         # (BQ, BKV)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_acc[:] += _dot(ds.astype(k.dtype), k)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, causal, block_q, block_kv, nq
+):
+    j, t = pl.program_id(2), pl.program_id(3)   # kv block, fused (rep, q block)
+    i = t % nq                                  # q block within the group step
+    nt = pl.num_programs(3)
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (not causal) or (j * block_kv <= i * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        do = do_ref[0, 0]
+        s = _dot(q, k, trans_b=True) * scale                       # (BQ, BKV)
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_kv)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        pt = p.astype(do.dtype).T
+        dv_acc[:] += _dot(pt, do)                                  # (BKV, D)
+        dp = _dot(do, v_ref[0, 0], trans_b=True)                   # (BQ, BKV)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dk_acc[:] += _dot(ds.astype(q.dtype).T, q)                 # (BKV, D)
+
+    @pl.when(t == nt - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
+    q, k, v, out, lse = res
+    b, h, s_q, d = q.shape
+    h_kv, s_k = k.shape[1], k.shape[2]
+    rep = h // h_kv
+    nq, nk = s_q // block_q, s_k // block_kv
+    do = g.astype(q.dtype)
+
+    # delta_i = sum_d dO_i * O_i — tiny elementwise reduce; XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: one sequential pass per KV block over (group rep × q blocks),
+    # so shared GQA KV heads accumulate all their query heads' contributions
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, nq=nq,
+    )
+
+    def qh(b, hkv, j, t):
+        return (b, hkv * rep + t // nq, t % nq, 0)
+
+    def qh2(b, hkv, j, t):
+        return (b, hkv * rep + t // nq, t % nq)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h_kv, nk, rep * nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), qh),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d), qh),
+            pl.BlockSpec((1, 1, block_q), qh2),
+            pl.BlockSpec((1, 1, block_q), qh2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public wrapper
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_kv, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret)
+    return out, (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over framework-layout tensors.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, Hkv, D) with Hkv | H (GQA).
+    Returns (B, Sq, H, D). Differentiable (custom VJP).
+    """
+    b, s_q, h, d = q.shape
+    s_k, h_kv = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    if s_q < LANES or s_k < LANES:
+        raise NotImplementedError(f"flash needs S >= {LANES}; got {s_q}/{s_k}")
+    block_q = block_q or _pick_block(s_q)
+    block_kv = block_kv or _pick_block(s_k)
+    if s_q % block_q or s_k % block_kv:
+        raise NotImplementedError("sequence lengths must tile into blocks")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+
+    # (B, S, H, D) -> (B, H, S, D); pad head_dim to a lane multiple
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d_pad = (LANES - d % LANES) % LANES
+    if d_pad:
+        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+
+    out = _flash(qt, kt, vt, float(scale), bool(causal), block_q, block_kv,
+                 bool(interpret))
+    if d_pad:
+        out = out[..., :d]
+    return jnp.swapaxes(out, 1, 2)

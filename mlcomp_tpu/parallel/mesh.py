@@ -89,6 +89,26 @@ def make_mesh(
     return Mesh(arr, AXES)
 
 
+_CURRENT_MESH: list = []
+
+
+def set_current_mesh(mesh: Optional[Mesh]):
+    """Install the process-wide mesh (Trainer does this); None to clear."""
+    _CURRENT_MESH.clear()
+    if mesh is not None:
+        _CURRENT_MESH.append(mesh)
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The installed mesh, if any — models use it for shard_map-based ops
+    (ring attention) that need explicit mesh access under jit."""
+    return _CURRENT_MESH[0] if _CURRENT_MESH else None
+
+
+def axis_size(mesh: Optional[Mesh], axis: str) -> int:
+    return int(mesh.shape[axis]) if mesh is not None and axis in mesh.shape else 1
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch-dimension sharding over every data-like axis (dp×fsdp×...)."""
     return NamedSharding(mesh, P(("dp", "fsdp")))

@@ -1,0 +1,125 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule, SPMD).
+
+The reference scales only by data-parallel replication (DDP); pipelining is
+another first-class axis of the TPU build. This is the collective-pipeline
+formulation (the one that maps onto an SPMD mesh instead of MPMD
+processes): every device runs the SAME program, holds ONE stage's slice of
+the stacked layer parameters (sharded over ``pp``), and activations hop to
+the next stage with ``lax.ppermute`` each tick. A microbatch enters at
+stage 0 every tick; after the ``n_stages - 1``-tick fill bubble, all
+stages compute every tick.
+
+Differentiable end-to-end (scan + ppermute + dynamic slices), so the
+backward pass is the mirrored drain schedule for free. ``remat=True``
+wraps the stage body in ``jax.checkpoint`` so the scan stores per-stage
+inputs instead of every intermediate — the standard memory/FLOPs trade.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    axis_name: str,
+    remat: bool,
+):
+    """Per-device body (inside shard_map).
+
+    stage_params: this stage's slice, leading axis of size 1 (from P(pp)).
+    microbatches: (M, mbs, ...), replicated; only stage 0 reads it.
+    Returns this device's output buffer (M, mbs, ...) — meaningful on the
+    last stage, which out_specs exposes as the stacked [-1] entry.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda x: x[0], stage_params)
+    n_micro = microbatches.shape[0]
+    total = n_micro + n_stages - 1
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def tick(carry, t):
+        cur, outputs = carry
+        # stage 0 ingests microbatch t (clamped; beyond M it's bubble junk
+        # that never reaches the output window)
+        mb = microbatches[jnp.minimum(t, n_micro - 1)]
+        cur = jnp.where(stage == 0, mb, cur)
+        out = fn(params, cur)
+        # drain: the last stage banks its result for microbatch t-(S-1)
+        slot = t - (n_stages - 1)
+        outputs = jax.lax.cond(
+            slot >= 0,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, out, jnp.maximum(slot, 0), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        # hop to the next stage (ring permute; the wraparound entry into
+        # stage 0 is overwritten by the next microbatch ingest)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        cur = jax.lax.ppermute(out, axis_name, perm)
+        return (cur, outputs), None
+
+    cur0 = jnp.zeros_like(microbatches[0])
+    out0 = jax.lax.pcast(
+        jnp.zeros_like(microbatches), (axis_name,), to="varying"
+    )
+    cur0 = jax.lax.pcast(cur0, (axis_name,), to="varying")
+    (cur, outputs), _ = jax.lax.scan(tick, (cur0, out0), jnp.arange(total))
+    return outputs[None]  # (1, M, mbs, ...): this stage's shard of the stack
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    n_microbatches: int,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    remat: bool = True,
+) -> jax.Array:
+    """Run ``x`` through ``n_stages`` pipelined stages.
+
+    - ``stage_fn(params_slice, h) -> h``: one stage; activations keep one
+      shape/dtype across stages (homogeneous trunk, e.g. decoder layers).
+    - ``stacked_params``: pytree whose leaves have a leading axis equal to
+      the ``pp`` mesh-axis size (one slice per stage).
+    - ``x``: (B, ...) global batch; B must divide into ``n_microbatches``.
+
+    Returns (B, ...) outputs after the last stage.
+    """
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
+    mb = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
+
+    run = jax.shard_map(
+        partial(
+            _pipeline_local,
+            stage_fn,
+            axis_name=axis_name,
+            remat=remat,
+        ),
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(axis_name),
+    )
+    stacked = run(stacked_params, mb)        # (n_stages, M, mbs, ...)
+    out = stacked[-1]                        # last stage's banked outputs
+    return out.reshape(b, *out.shape[2:])
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage param pytrees along a new leading axis for P(pp)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)

@@ -1,0 +1,142 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context attention where the sequence is sharded across devices: each
+device keeps its Q shard resident and the K/V shards rotate around the ring
+via ``lax.ppermute`` (XLA lowers this to ICI neighbor exchange), with the
+softmax accumulated online — max/sum renormalization per incoming block —
+so no device ever materializes more than its (S/n)² tile of logits.
+
+The reference has nothing like this (its only parallelism is DDP
+data-parallel); sequence parallelism is a first-class capability of the
+TPU build. The math is the same blocked online softmax as the Pallas flash
+kernel (ops/pallas/flash_attention.py), lifted one level up: blocks are
+device shards, the inner loop is a ``lax.scan`` over ring steps, and the
+rotation overlaps with the block compute under XLA's scheduler (the
+ppermute for step i+1 has no data dependency on step i's einsum).
+
+Differentiable by construction (pure jnp + ppermute, which is its own
+transpose), so the backward pass is another ring pass — no custom VJP.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, row0, col0, causal, scale):
+    """One Q-shard × KV-shard tile, GQA-aware, fp32 accumulation.
+
+    Returns (unnormalized_out, block_max, block_sum) for online merging.
+    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D); row0/col0: global offsets.
+    """
+    b, s_q, h, d = q.shape
+    s_k, h_kv = k.shape[1], k.shape[2]
+    rep = h // h_kv
+    qg = q.reshape(b, s_q, h_kv, rep, d)
+    s = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32) * scale
+    if causal:
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        s = jnp.where((rows >= cols)[None, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                    # (B,Hkv,rep,Sq,1)
+    # clamp fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0) = 1
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe) * (s > NEG_INF / 2).astype(jnp.float32)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhrqk,bkhd->bhrqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m_safe, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call INSIDE shard_map/jit-with-sharding: q, k, v are the per-device
+    shards (B, S_local, H|Hkv, D), sequence-contiguous in ring order.
+    Returns the local output shard (B, S_local, H, D).
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    row0 = me * s_q
+    h_kv = k.shape[2]
+    rep = h // h_kv
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k_blk, v_blk, acc, m, l = carry
+        src = (me - i) % n                      # whose shard we hold now
+        # rotate first: the collective has no dependency on this step's
+        # compute, so XLA can overlap ICI transfer with the einsums
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, row0, src * s_k, causal, scale)
+        m_new = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_b - m_new)
+        acc = acc * alpha + o_b * beta
+        l = l * alpha + l_b * beta
+        return (k_nxt, v_nxt, acc, m_new, l), None
+
+    # the zero-init accumulators must carry the same varying-axes type as
+    # the inputs (their values diverge per device from step 0) or the scan
+    # carry types won't match; a zero scalar derived from q inherits
+    # exactly the axes the enclosing shard_map shards over
+    zero = q.reshape(-1)[0].astype(jnp.float32) * 0.0
+    acc0 = jnp.zeros((b, h_kv, rep, s_q, d), jnp.float32) + zero
+    m0 = jnp.full((b, h_kv, rep, s_q, 1), NEG_INF / 2, jnp.float32) + zero
+    l0 = jnp.zeros((b, h_kv, rep, s_q, 1), jnp.float32) + zero
+    (_, _, acc, m, l), _ = jax.lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(n), length=n
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    # (B, Hkv, rep, Sq, D) -> (B, Sq, H, D)
+    out = jnp.moveaxis(out, 3, 1).reshape(b, s_q, h, d)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """shard_map wrapper: global (B, S, H, D) arrays, S sharded over sp.
+
+    Batch additionally shards over the data axes and heads over tp (when
+    divisible), so dp/tp replicas don't redundantly recompute — only the
+    sp dimension runs the ring.
+    """
+    b, _, h, _ = q.shape
+    h_kv = k.shape[2]
+    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    batch_axes = ("dp", "fsdp") if b % max(dp, 1) == 0 else None
+    tp = mesh.shape.get("tp", 1)
+    head_axis = "tp" if tp > 1 and h % tp == 0 and h_kv % tp == 0 else None
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

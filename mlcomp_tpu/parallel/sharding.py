@@ -1,0 +1,137 @@
+"""Parameter/state sharding rules: fsdp + tensor parallelism, one rule pass.
+
+The reference's only parallelism is data-parallel DDP (NCCL all-reduce of
+replicated grads). TPU-native training shards the *state* too:
+
+- ``fsdp``: every large parameter is sharded over the ``fsdp`` mesh axis on
+  its largest divisible dimension (ZeRO-3 style); XLA inserts the
+  all-gathers on use and reduce-scatters on the gradient;
+- ``tp``: named-pattern rules shard transformer weights over ``tp``
+  (attention heads, MLP hidden dim, vocab) so the big matmuls are
+  Megatron-partitioned and XLA rides the collectives over ICI.
+
+One rule function is applied over the WHOLE TrainState pytree (params,
+optimizer moments, BN stats): optimizer-state leaves mirror the param tree
+path-wise, so the same pattern match lands the same spec on the matching
+moments — no special casing per optimizer.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, ((dim, axis), ...)) — matched against "/".join(path keys).
+# Dims index into the *leaf* shape; negative dims count from the end.
+# Patterns mirror the model zoo's naming (models/transformer.py, bert.py,
+# moe.py). Each rule may pin several dims (e.g. MoE: experts over ep AND
+# the ffn dim over tp).
+TP_RULES: List[Tuple[str, Tuple[Tuple[int, str], ...]]] = [
+    (r"(^|/)(q|k|v)/kernel$", ((-2, "tp"),)),   # (hidden, heads, d_head): heads
+    (r"(^|/)out/kernel$", ((0, "tp"),)),        # (heads, d_head, hidden): heads
+    (r"(^|/)(gate|up)/kernel$", ((-1, "tp"),)), # (hidden, mlp): mlp
+    (r"(^|/)down/kernel$", ((0, "tp"),)),       # (mlp, hidden): mlp
+    (r"(^|/)emb/embedding$", ((-1, "tp"),)),    # (vocab, hidden): hidden
+    (r"(^|/)lm_head/kernel$", ((-1, "tp"),)),   # (hidden, vocab): vocab
+    (r"(^|/)(query|key|value)/kernel$", ((-2, "tp"),)),  # bert naming
+    (r"(^|/)attn_out/kernel$", ((0, "tp"),)),
+    (r"(^|/)(mlp_in|intermediate)/kernel$", ((-1, "tp"),)),
+    (r"(^|/)(mlp_out|output)/kernel$", ((0, "tp"),)),
+    # MoE stacked expert weights (E, d, f)/(E, f, d): experts over ep,
+    # ffn dim over tp
+    (r"(^|/)experts_w1$", ((0, "ep"), (-1, "tp"))),
+    (r"(^|/)experts_w2$", ((0, "ep"), (-2, "tp"))),
+    (r"(^|/)router/kernel$", ()),               # tiny; keep replicated
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for(
+    path: str,
+    shape: Sequence[int],
+    mesh: Mesh,
+    tp_rules: Optional[List[Tuple[str, int, str]]] = None,
+    fsdp_min_size: int = 2**14,
+) -> P:
+    """PartitionSpec for one leaf: tp pattern first, fsdp default after.
+
+    fsdp shards the largest *remaining* divisible dim, so a tp-sharded
+    matrix still gets fsdp on its other dimension when both axes are >1
+    (the standard 2D layout). Leaves smaller than ``fsdp_min_size``
+    elements (biases, norm scales, BN stats) stay replicated — gathering
+    them costs more than storing them.
+    """
+    if not shape:
+        return P()
+    ndim = len(shape)
+    spec: List = [None] * ndim
+    for pat, dims in tp_rules if tp_rules is not None else TP_RULES:
+        if re.search(pat, path):
+            for dim, axis in dims:
+                n = mesh.shape.get(axis, 1)
+                d = dim % ndim
+                if n > 1 and shape[d] % n == 0 and spec[d] is None:
+                    spec[d] = axis
+            break
+    fsdp = mesh.shape.get("fsdp", 1)
+    size = 1
+    for s in shape:
+        size *= s
+    if fsdp > 1 and ndim >= 2 and size >= fsdp_min_size:
+        # largest unclaimed dim divisible by the fsdp axis size
+        cands = [
+            d for d in range(ndim) if spec[d] is None and shape[d] % fsdp == 0
+        ]
+        if cands:
+            d = max(cands, key=lambda d: shape[d])
+            spec[d] = "fsdp"
+    while spec and spec[-1] is None:
+        spec.pop()
+    return P(*spec)
+
+
+def state_shardings(
+    abstract_state,
+    mesh: Mesh,
+    tp_rules: Optional[List[Tuple[str, int, str]]] = None,
+):
+    """NamedSharding pytree for a TrainState (from ``jax.eval_shape``).
+
+    Optimizer moments carry the param path as a suffix of their own path,
+    so tp/fsdp specs land consistently on params and their moments.
+    """
+
+    def rule(path, leaf):
+        return NamedSharding(
+            mesh, spec_for(_path_str(path), leaf.shape, mesh, tp_rules)
+        )
+
+    return jax.tree_util.tree_map_with_path(rule, abstract_state)
+
+
+def make_sharded_state(init_fn, mesh: Mesh, *args, tp_rules=None):
+    """Run ``init_fn(*args) -> TrainState`` with sharded outputs.
+
+    The init executes under jit with ``out_shardings`` computed from the
+    abstract state, so each device materializes only its own shard —
+    parameters larger than one host's memory never exist unsharded.
+    """
+    abstract = jax.eval_shape(init_fn, *args)
+    shardings = state_shardings(abstract, mesh, tp_rules)
+    return jax.jit(init_fn, out_shardings=shardings)(*args), shardings

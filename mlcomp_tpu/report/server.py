@@ -1,0 +1,179 @@
+"""HTTP report server over the sqlite task store (stdlib only).
+
+Endpoints (all JSON unless noted):
+
+- ``GET /``                                 HTML dashboard
+- ``GET /api/dags``                         all dags + task status counts
+- ``GET /api/dags/<id>/tasks``              task rows for one dag
+- ``GET /api/tasks/<id>/logs``              log lines
+- ``GET /api/tasks/<id>/metrics``           metric names
+- ``GET /api/tasks/<id>/metrics/<name>``    one metric series [[step, value]]
+- ``GET /api/workers``                      worker heartbeats
+
+Each request opens its own Store handle (sqlite connections are not
+thread-safe across the ThreadingHTTPServer pool; WAL mode makes the
+per-request open cheap and concurrent-reader-safe).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+from mlcomp_tpu.db.store import Store
+
+_ROUTES = [
+    (re.compile(r"^/api/dags$"), "dags"),
+    (re.compile(r"^/api/dags/(\d+)/tasks$"), "dag_tasks"),
+    (re.compile(r"^/api/tasks/(\d+)/logs$"), "task_logs"),
+    (re.compile(r"^/api/tasks/(\d+)/metrics$"), "metric_names"),
+    (re.compile(r"^/api/tasks/(\d+)/metrics/([\w./-]+)$"), "metric_series"),
+    (re.compile(r"^/api/workers$"), "workers"),
+]
+
+_DASHBOARD = """<!doctype html>
+<html><head><meta charset="utf-8"><title>mlcomp-tpu</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa}
+h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+table{border-collapse:collapse;width:100%;background:#fff}
+td,th{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
+th{background:#f0f0f0}
+.success{color:#0a7d38}.failed{color:#c0262d}.in_progress{color:#b07a00}
+.not_ran,.queued{color:#777}
+pre{background:#111;color:#dedede;padding:.8rem;font-size:.75rem;overflow:auto}
+</style></head><body>
+<h1>mlcomp-tpu report</h1>
+<h2>DAGs</h2><table id="dags"></table>
+<h2>Tasks <span id="dagsel"></span></h2><table id="tasks"></table>
+<h2>Workers</h2><table id="workers"></table>
+<h2>Logs / metrics <span id="tasksel"></span></h2><pre id="detail">select a task</pre>
+<script>
+const J=u=>fetch(u).then(r=>r.json());
+let curDag=null;
+function row(tr,cells,head){const r=document.createElement('tr');
+ for(const c of cells){const d=document.createElement(head?'th':'td');
+  if(c instanceof Node)d.appendChild(c);else{d.textContent=c[0]??c;
+   if(Array.isArray(c)&&c[1])d.className=c[1];}r.appendChild(d);}
+ tr.appendChild(r);}
+async function refresh(){
+ const dags=await J('/api/dags');const t=document.getElementById('dags');
+ t.innerHTML='';row(t,['id','name','project','status','tasks'],true);
+ for(const d of dags){const a=document.createElement('a');a.href='#';
+  a.textContent=d.id;a.onclick=()=>{curDag=d.id;refresh();return false};
+  row(t,[a,d.name,d.project,[d.status,d.status],JSON.stringify(d.counts)]);}
+ if(curDag===null&&dags.length)curDag=dags[dags.length-1].id;
+ if(curDag!==null){
+  document.getElementById('dagsel').textContent='(dag '+curDag+')';
+  const tasks=await J('/api/dags/'+curDag+'/tasks');
+  const tt=document.getElementById('tasks');tt.innerHTML='';
+  row(tt,['id','name','executor','stage','status','worker','error'],true);
+  for(const x of tasks){const a=document.createElement('a');a.href='#';
+   a.textContent=x.id;a.onclick=()=>{showTask(x.id);return false};
+   row(tt,[a,x.name,x.executor,x.stage,[x.status,x.status],x.worker||'',x.error||'']);}}
+ const ws=await J('/api/workers');const wt=document.getElementById('workers');
+ wt.innerHTML='';row(wt,['name','chips','busy','status','heartbeat'],true);
+ for(const w of ws)row(wt,[w.name,w.chips,w.busy_chips,[w.status,w.status==='alive'?'success':'failed'],
+  new Date(w.heartbeat*1000).toLocaleTimeString()]);
+}
+async function showTask(id){
+ document.getElementById('tasksel').textContent='(task '+id+')';
+ const names=await J('/api/tasks/'+id+'/metrics');let out='';
+ for(const n of names){const s=await J('/api/tasks/'+id+'/metrics/'+n);
+  out+='metric '+n+': '+s.map(p=>p[1].toFixed?p[1].toFixed(4):p[1]).join(' ')+'\\n';}
+ const logs=await J('/api/tasks/'+id+'/logs');
+ for(const l of logs)out+='['+l.level+'] '+l.message+'\\n';
+ document.getElementById('detail').textContent=out||'(empty)';
+}
+refresh();setInterval(refresh,3000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    db_path: str = ""
+
+    def log_message(self, *args):  # quiet by default; logs go to the store
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj: Any, code: int = 200) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        if path in ("/", "/index.html"):
+            self._send(200, _DASHBOARD.encode(), "text/html; charset=utf-8")
+            return
+        for pat, name in _ROUTES:
+            m = pat.match(path)
+            if m:
+                store = Store(self.db_path)
+                try:
+                    self._json(getattr(self, f"_r_{name}")(store, *m.groups()))
+                except Exception as e:  # surface, don't kill the thread
+                    self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+                finally:
+                    store.close()
+                return
+        self._json({"error": "not found"}, code=404)
+
+    # ---- route impls -----------------------------------------------------
+
+    def _r_dags(self, store: Store):
+        dags = store.list_dags()
+        for d in dags:
+            counts: dict = {}
+            for s in store.task_statuses(d["id"]).values():
+                counts[s.value] = counts.get(s.value, 0) + 1
+            d["counts"] = counts
+        return dags
+
+    def _r_dag_tasks(self, store: Store, dag_id: str):
+        return store.task_rows(int(dag_id))
+
+    def _r_task_logs(self, store: Store, task_id: str):
+        return store.task_logs(int(task_id))
+
+    def _r_metric_names(self, store: Store, task_id: str):
+        return store.metric_names(int(task_id))
+
+    def _r_metric_series(self, store: Store, task_id: str, name: str):
+        return store.metric_series(int(task_id), name)
+
+    def _r_workers(self, store: Store):
+        return store.workers()
+
+
+def make_server(
+    db_path: str, host: str = "127.0.0.1", port: int = 8765
+) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (_Handler,), {"db_path": db_path})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def start_in_thread(
+    db_path: str, host: str = "127.0.0.1", port: int = 0
+) -> Tuple[ThreadingHTTPServer, int]:
+    """Start on an ephemeral port; returns (server, bound_port)."""
+    srv = make_server(db_path, host, port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]
+
+
+def serve(db_path: str, host: str = "127.0.0.1", port: int = 8765) -> None:
+    srv = make_server(db_path, host, port)
+    print(f"mlcomp-tpu report server on http://{host}:{port} (db: {db_path})")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.shutdown()

@@ -36,7 +36,6 @@ from mlcomp_tpu.train.state import TrainState, init_model, param_count
 def make_train_step(
     loss_fn,
     metric_fns: Dict[str, Callable],
-    has_model_state: bool,
     rng_key: Optional[jax.Array] = None,
 ):
     """Build the pure train step; jitted once, reused every step.
@@ -51,20 +50,20 @@ def make_train_step(
 
         def loss_of(params):
             variables = {"params": params, **state.model_state}
-            if has_model_state:
-                outputs, new_model_state = state.apply_fn(
-                    variables,
-                    batch["x"],
-                    train=True,
-                    mutable=list(state.model_state),
-                    rngs=step_rngs,
-                )
-            else:
-                outputs = state.apply_fn(
-                    variables, batch["x"], train=True, rngs=step_rngs
-                )
-                new_model_state = state.model_state
+            # 'losses' is always mutable: modules sow auxiliary objectives
+            # there (e.g. MoE load-balance loss) and the step adds them in
+            outputs, new_model_state = state.apply_fn(
+                variables,
+                batch["x"],
+                train=True,
+                mutable=list(state.model_state) + ["losses"],
+                rngs=step_rngs,
+            )
+            new_model_state = dict(new_model_state)
+            sown = new_model_state.pop("losses", {})
             loss = loss_fn(outputs, batch)
+            for leaf in jax.tree.leaves(sown):
+                loss = loss + jnp.sum(leaf)
             return loss, (outputs, new_model_state)
 
         (loss, (outputs, new_model_state)), grads = jax.value_and_grad(
@@ -110,6 +109,10 @@ class Trainer:
         self.mesh = mesh if mesh is not None else make_mesh(
             MeshSpec.from_config(cfg.get("mesh"))
         )
+        # models reach the mesh for shard_map-based ops (ring attention)
+        from mlcomp_tpu.parallel.mesh import set_current_mesh
+
+        set_current_mesh(self.mesh)
 
         datasets = cfg.get("data", {})
         self.loaders: Dict[str, DataLoader] = {}
@@ -133,19 +136,26 @@ class Trainer:
         # peek raw arrays (not _host_batches: that would shuffle and advance
         # the loader's epoch counter before training starts)
         split0 = "train" if "train" in self.loaders else next(iter(self.loaders))
-        sample_x = self._loader(split0).data["x"][:1]
-        params, model_state = init_model(
-            self.model, {"x": jnp.asarray(sample_x)}, jax.random.PRNGKey(self.seed)
+        sample_x = jnp.asarray(self._loader(split0).data["x"][:1])
+
+        def _create_state():
+            params, model_state = init_model(
+                self.model, {"x": sample_x}, jax.random.PRNGKey(self.seed)
+            )
+            return TrainState.create(self.model.apply, params, self.tx, model_state)
+
+        # fsdp/tp-aware sharded init: each device materializes only its own
+        # shard (parallel/sharding.py); pure-dp meshes resolve to replicated
+        from mlcomp_tpu.parallel.sharding import make_sharded_state
+
+        self.state, self.state_shardings = make_sharded_state(
+            _create_state, self.mesh
         )
-        state = TrainState.create(self.model.apply, params, self.tx, model_state)
-        self.state = jax.device_put(state, replicated(self.mesh))
-        self.has_model_state = bool(model_state)
 
         self._train_step = jax.jit(
             make_train_step(
                 self.loss_fn,
                 self.metric_fns,
-                self.has_model_state,
                 rng_key=jax.random.PRNGKey(self.seed + 1),
             ),
             donate_argnums=(0,),

@@ -68,6 +68,16 @@ def pixel_cross_entropy(logits, batch):
     return masked_mean(per, batch)
 
 
+@LOSSES.register("lm_cross_entropy")
+def lm_cross_entropy(logits, batch):
+    """Next-token CE for decoder LMs: logits (B,S,V), inputs batch['x']."""
+    ids = batch["x"].astype(jnp.int32)
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], ids[:, 1:]
+    ).mean(axis=-1)
+    return masked_mean(per, batch)
+
+
 @LOSSES.register("dice")
 def dice_loss(logits, batch, eps: float = 1e-6):
     """Soft dice over one-hot classes; segmentation complement to pixel CE."""

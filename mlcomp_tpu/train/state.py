@@ -59,6 +59,7 @@ def init_model(model, sample_batch, rng: Optional[jax.Array] = None):
     # dict() so .pop has plain-dict semantics even if flax returns FrozenDict
     variables = dict(model.init(rng, sample_batch["x"], train=False))
     params = variables.pop("params", {})
+    variables.pop("losses", None)  # sown aux objectives are per-step, not state
     return params, variables
 
 

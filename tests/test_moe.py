@@ -1,0 +1,69 @@
+"""MoE routing correctness + ep-sharded training on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlcomp_tpu.models import create_model
+from mlcomp_tpu.models.moe import MoEBlock
+
+
+def test_moe_block_routes_and_sows_aux():
+    block = MoEBlock(n_experts=4, d_model=16, d_ff=32, k=2,
+                     capacity_factor=2.0, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).normal(size=(2, 8, 16)), jnp.float32)
+    variables = dict(block.init(jax.random.PRNGKey(0), x))
+    variables.pop("losses", None)  # same as train.state.init_model
+    out, state = block.apply(variables, x, mutable=["losses"])
+    assert out.shape == x.shape
+    aux = jax.tree.leaves(state["losses"])
+    assert len(aux) == 1 and np.isfinite(float(aux[0]))
+    # with generous capacity almost no tokens drop; output should be nonzero
+    assert float(jnp.abs(out).mean()) > 1e-4
+
+
+def test_moe_capacity_drops_tokens():
+    # capacity 1 slot/expert: most tokens dropped -> output rows mostly zero
+    block = MoEBlock(n_experts=2, d_model=8, d_ff=16, k=1,
+                     capacity_factor=0.1, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(1).normal(size=(1, 32, 8)), jnp.float32)
+    variables = block.init(jax.random.PRNGKey(0), x)
+    out, _ = block.apply(variables, x, mutable=["losses"])
+    row_norms = np.asarray(jnp.linalg.norm(out[0], axis=-1))
+    assert (row_norms < 1e-6).sum() >= 28  # ~2 slots of 32 survive
+
+
+def test_moe_lm_forward():
+    model = create_model({
+        "name": "moe_lm", "vocab_size": 64, "hidden": 32, "layers": 2,
+        "heads": 4, "n_experts": 4, "d_ff": 64, "moe_every": 2,
+        "dtype": "float32",
+    })
+    x = jnp.asarray(np.random.RandomState(2).randint(0, 64, (2, 16)))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 16, 64)
+
+
+def test_moe_lm_trains_with_ep_sharding():
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "moe_lm", "vocab_size": 64, "hidden": 32,
+                  "layers": 2, "heads": 4, "n_experts": 4, "d_ff": 64,
+                  "moe_every": 2, "dtype": "float32"},
+        "optimizer": {"name": "adam", "lr": 1e-3},
+        "loss": "lm_cross_entropy",
+        "metrics": [],
+        "epochs": 1,
+        "mesh": {"dp": 2, "ep": 4},
+        "data": {
+            "train": {"name": "synthetic_tokens", "n": 32, "seq_len": 16,
+                      "vocab_size": 64, "batch_size": 16},
+        },
+    }
+    tr = Trainer(cfg)
+    w1 = tr.state.params["MoELayer_0"]["moe"]["experts_w1"]
+    assert "ep" in w1.sharding.spec, w1.sharding.spec
+    stats = tr.train_epoch()
+    assert np.isfinite(stats["loss"])

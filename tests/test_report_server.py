@@ -1,0 +1,81 @@
+"""Report server endpoints over a live ephemeral-port HTTP server."""
+
+import json
+import urllib.request
+
+import pytest
+
+from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.report.server import start_in_thread
+
+
+@pytest.fixture()
+def served(tmp_db):
+    store = Store(tmp_db)
+    dag = DagSpec(
+        name="demo",
+        project="p",
+        tasks=(
+            TaskSpec(name="a", executor="noop", stage="train"),
+            TaskSpec(name="b", executor="noop", stage="valid", depends=("a",)),
+        ),
+    )
+    dag_id = store.submit_dag(dag)
+    rows = store.task_rows(dag_id)
+    tid = rows[0]["id"]
+    store.log(tid, "info", "hello from a")
+    store.metric(tid, "train/loss", 0.5, step=0)
+    store.metric(tid, "train/loss", 0.25, step=1)
+    store.heartbeat("worker-0", chips=8)
+    srv, port = start_in_thread(tmp_db)
+    yield store, dag_id, tid, port
+    srv.shutdown()
+    store.close()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.read()
+
+
+def test_dashboard_html(served):
+    *_, port = served
+    status, body = _get(port, "/")
+    assert status == 200 and b"mlcomp-tpu report" in body
+
+
+def test_api_dags_and_tasks(served):
+    _, dag_id, _, port = served
+    status, body = _get(port, "/api/dags")
+    dags = json.loads(body)
+    assert status == 200 and dags[0]["name"] == "demo"
+    assert dags[0]["counts"] == {"not_ran": 2}
+
+    status, body = _get(port, f"/api/dags/{dag_id}/tasks")
+    tasks = json.loads(body)
+    assert [t["name"] for t in tasks] == ["a", "b"]
+
+
+def test_api_logs_metrics_workers(served):
+    _, _, tid, port = served
+    _, body = _get(port, f"/api/tasks/{tid}/logs")
+    assert json.loads(body)[0]["message"] == "hello from a"
+
+    _, body = _get(port, f"/api/tasks/{tid}/metrics")
+    assert json.loads(body) == ["train/loss"]
+
+    _, body = _get(port, f"/api/tasks/{tid}/metrics/train/loss")
+    assert json.loads(body) == [[0, 0.5], [1, 0.25]]
+
+    _, body = _get(port, "/api/workers")
+    assert json.loads(body)[0]["name"] == "worker-0"
+
+
+def test_api_404(served):
+    *_, port = served
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/api/nothing")
+    assert ei.value.code == 404

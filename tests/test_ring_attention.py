@@ -1,0 +1,57 @@
+"""Ring attention over the sp axis vs single-device reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlcomp_tpu.ops.attention import reference_attention
+from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh
+from mlcomp_tpu.parallel.ring import ring_attention_sharded
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.RandomState(seed).normal(size=shape), jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(causal):
+    mesh = make_mesh(MeshSpec(sp=8))
+    q = _rand((2, 64, 4, 16), 0)
+    k = _rand((2, 64, 4, 16), 1)
+    v = _rand((2, 64, 4, 16), 2)
+    out = jax.jit(
+        lambda q, k, v: ring_attention_sharded(q, k, v, mesh, causal=causal)
+    )(q, k, v)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_gqa():
+    mesh = make_mesh(MeshSpec(sp=4))
+    q = _rand((1, 32, 4, 16), 3)
+    k = _rand((1, 32, 2, 16), 4)
+    v = _rand((1, 32, 2, 16), 5)
+    out = jax.jit(lambda q, k, v: ring_attention_sharded(q, k, v, mesh, causal=True))(
+        q, k, v
+    )
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_differentiable():
+    mesh = make_mesh(MeshSpec(sp=4))
+    q = _rand((1, 32, 2, 16), 6)
+    k = _rand((1, 32, 2, 16), 7)
+    v = _rand((1, 32, 2, 16), 8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    ge = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

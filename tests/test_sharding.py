@@ -1,0 +1,78 @@
+"""fsdp/tp sharding rules on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh
+from mlcomp_tpu.parallel.sharding import spec_for, make_sharded_state
+
+
+def test_spec_for_tp_patterns():
+    mesh = make_mesh(MeshSpec(dp=2, tp=4, fsdp=1))
+    assert spec_for("layer_0/q/kernel", (512, 8, 64), mesh) == P(None, "tp")
+    assert spec_for("layer_0/out/kernel", (8, 64, 512), mesh) == P("tp")
+    assert spec_for("layer_0/gate/kernel", (512, 2048), mesh) == P(None, "tp")
+    assert spec_for("emb/embedding", (32000, 512), mesh) == P(None, "tp")
+    # small leaves stay replicated
+    assert spec_for("norm/scale", (512,), mesh) == P()
+
+
+def test_spec_for_fsdp_largest_dim():
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=4))
+    assert spec_for("dense/kernel", (256, 1024), mesh) == P(None, "fsdp")
+    assert spec_for("dense2/kernel", (1024, 256), mesh) == P("fsdp")
+    # tiny params not worth gathering
+    assert spec_for("bias", (128,), mesh) == P()
+
+
+def test_spec_for_tp_plus_fsdp_2d():
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=2, tp=4))
+    # tp claims the mlp dim, fsdp lands on the other
+    assert spec_for("gate/kernel", (512, 2048), mesh) == P("fsdp", "tp")
+
+
+def test_trainer_fsdp_state_is_sharded_and_trains():
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "mlp", "hidden": [256, 256], "num_classes": 10},
+        "optimizer": {"name": "adam", "lr": 1e-3},
+        "epochs": 1,
+        "mesh": {"dp": 2, "fsdp": 4},
+        "data": {
+            "train": {"name": "synthetic_classification", "n": 64, "dim": 128,
+                      "num_classes": 10, "batch_size": 32},
+        },
+    }
+    tr = Trainer(cfg)
+    # at least one param leaf actually sharded over fsdp
+    specs = [l.sharding.spec for l in jax.tree.leaves(tr.state.params)]
+    assert any("fsdp" in s for s in specs), specs
+    stats = tr.train_epoch()
+    assert np.isfinite(stats["loss"])
+
+
+def test_trainer_tp_transformer_trains():
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "transformer_lm", "vocab_size": 128, "hidden": 64,
+                  "layers": 2, "heads": 4, "mlp_dim": 128, "dtype": "float32"},
+        "optimizer": {"name": "adam", "lr": 1e-3},
+        "loss": "lm_cross_entropy",
+        "metrics": [],
+        "epochs": 1,
+        "mesh": {"dp": 2, "tp": 4},
+        "data": {
+            "train": {"name": "synthetic_tokens", "n": 32, "seq_len": 16,
+                      "vocab_size": 128, "batch_size": 16},
+        },
+    }
+    tr = Trainer(cfg)
+    q_kernel = tr.state.params["DecoderLayer_0"]["attn"]["q"]["kernel"]
+    assert "tp" in q_kernel.sharding.spec, q_kernel.sharding.spec
+    stats = tr.train_epoch()
+    assert np.isfinite(stats["loss"])
