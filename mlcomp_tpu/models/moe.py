@@ -125,7 +125,7 @@ class MoELayer(nn.Module):
     k: int
     capacity_factor: float
     dtype: jnp.dtype
-    seq_parallel: bool = False
+    seq_parallel: "bool | str" = False
 
     @nn.compact
     def __call__(self, x, positions, train: bool = False):
@@ -162,7 +162,7 @@ class MoELM(nn.Module):
     capacity_factor: float = 1.25
     moe_every: int = 2
     dtype: str = "bfloat16"
-    seq_parallel: bool = False
+    seq_parallel: "bool | str" = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):

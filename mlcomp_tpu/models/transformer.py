@@ -59,9 +59,10 @@ class SelfAttention(nn.Module):
     heads: int
     kv_heads: int
     dtype: jnp.dtype
-    # route attention through ring attention when the current mesh has an
-    # sp axis > 1 (sequence/context parallelism for long sequences)
-    seq_parallel: bool = False
+    # sequence/context parallelism when the current mesh has an sp axis > 1:
+    # True/"ring" = ring attention (sp unbounded, O(S/n) resident);
+    # "ulysses" = all-to-all head exchange (sp ≤ kv_heads, denser kernels)
+    seq_parallel: "bool | str" = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -78,10 +79,24 @@ class SelfAttention(nn.Module):
         if self.seq_parallel:
             from mlcomp_tpu.parallel.mesh import axis_size, current_mesh
             from mlcomp_tpu.parallel.ring import ring_attention_sharded
+            from mlcomp_tpu.parallel.ulysses import ulysses_attention_sharded
 
+            mode = (
+                "ring" if self.seq_parallel is True else str(self.seq_parallel)
+            )
+            sp_attn = {
+                "ring": ring_attention_sharded,
+                "ulysses": ulysses_attention_sharded,
+            }
+            # validate even when sp == 1, so a typo'd mode fails on the
+            # first dev run rather than first pod launch
+            if mode not in sp_attn:
+                raise ValueError(
+                    f"seq_parallel={mode!r}: expected 'ring' or 'ulysses'"
+                )
             mesh = current_mesh()
             if axis_size(mesh, "sp") > 1:
-                attn = ring_attention_sharded(q, k, v, mesh, causal=True)
+                attn = sp_attn[mode](q, k, v, mesh, causal=True)
         if attn is None:
             attn = dot_product_attention(q, k, v, causal=True)
         return x + nn.DenseGeneral(
@@ -95,7 +110,7 @@ class DecoderLayer(nn.Module):
     kv_heads: int
     mlp_dim: int
     dtype: jnp.dtype
-    seq_parallel: bool = False
+    seq_parallel: "bool | str" = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -119,7 +134,7 @@ class TransformerLM(nn.Module):
     kv_heads: Optional[int] = None
     mlp_dim: Optional[int] = None
     dtype: str = "bfloat16"
-    seq_parallel: bool = False
+    seq_parallel: "bool | str" = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):

@@ -4,5 +4,20 @@ from mlcomp_tpu.parallel.mesh import (
     batch_sharding,
     replicated,
 )
+from mlcomp_tpu.parallel.distributed import (
+    init_distributed,
+    make_hybrid_mesh,
+    global_batch_from_host,
+    sync_hosts,
+)
 
-__all__ = ["MeshSpec", "make_mesh", "batch_sharding", "replicated"]
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "init_distributed",
+    "make_hybrid_mesh",
+    "global_batch_from_host",
+    "sync_hosts",
+]

@@ -114,6 +114,36 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(("dp", "fsdp")))
 
 
+def seq_shard_spec(
+    mesh: Mesh,
+    batch: int,
+    heads: int,
+    kv_heads: int,
+    axis_name: str = "sp",
+    heads_split_sp: bool = False,
+) -> P:
+    """PartitionSpec for (B, S, H, D) attention operands under seq parallelism.
+
+    One policy shared by the ring and Ulysses wrappers: batch over the data
+    axes when divisible, sequence over ``axis_name``, heads over tp when
+    both head counts divide tp.  ``heads_split_sp`` additionally requires
+    the per-tp head counts to divide the sp axis (Ulysses' all-to-all
+    splits the local head axis sp ways; the ring never touches heads).
+    """
+    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    batch_axes = ("dp", "fsdp") if batch % max(dp, 1) == 0 else None
+    tp = mesh.shape.get("tp", 1)
+    head_axis = None
+    if tp > 1 and heads % tp == 0 and kv_heads % tp == 0:
+        if not heads_split_sp:
+            head_axis = "tp"
+        else:
+            sp = mesh.shape.get(axis_name, 1)
+            if (heads // tp) % sp == 0 and (kv_heads // tp) % sp == 0:
+                head_axis = "tp"
+    return P(batch_axes, axis_name, head_axis, None)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -126,13 +126,11 @@ def ring_attention_sharded(
     divisible), so dp/tp replicas don't redundantly recompute — only the
     sp dimension runs the ring.
     """
+    from mlcomp_tpu.parallel.mesh import seq_shard_spec
+
     b, _, h, _ = q.shape
     h_kv = k.shape[2]
-    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-    batch_axes = ("dp", "fsdp") if b % max(dp, 1) == 0 else None
-    tp = mesh.shape.get("tp", 1)
-    head_axis = "tp" if tp > 1 and h % tp == 0 and h_kv % tp == 0 else None
-    spec = P(batch_axes, axis_name, head_axis, None)
+    spec = seq_shard_spec(mesh, b, h, h_kv, axis_name)
     fn = jax.shard_map(
         partial(ring_attention, axis_name=axis_name, causal=causal, scale=scale),
         mesh=mesh,

@@ -1,0 +1,105 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second of the framework's two long-context strategies (the other being
+the ring pass in ``parallel.ring``).  The sequence arrives sharded over the
+``sp`` axis; two ``lax.all_to_all`` collectives re-shard Q/K/V from
+sequence-sharded to HEAD-sharded, so every device runs ordinary full-sequence
+attention on H/n heads — reusing the Pallas flash kernel unchanged — and a
+final all-to-all restores sequence sharding for the output projection.
+
+Trade-off vs the ring (why both exist):
+
+- Ulysses moves each token's QKV exactly once per direction (2 all-to-alls
+  of S·H·D/n per device) regardless of sequence length, and keeps the
+  attention itself a single dense kernel — better MXU utilization, and the
+  all-to-all rides ICI's full bisection rather than neighbor hops.
+- But it caps sp at the head count (needs heads % sp == 0, and GQA KV heads
+  % sp == 0), and holds the FULL sequence of its head shard resident —
+  O(S·H/n·D) activations.  The ring shards the sequence everywhere
+  (O(S/n) resident) and scales sp past the head count, at the cost of n
+  neighbor exchanges.
+
+Rule of thumb: Ulysses while sp ≤ kv_heads, ring beyond.  The attention
+dispatch in ``models.transformer`` picks by config.
+
+Differentiable by construction: all_to_all is its own transpose, so autodiff
+derives the backward pass (the same two collectives, reversed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mlcomp_tpu.ops.attention import dot_product_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call INSIDE shard_map/jit-with-sharding: q (B, S/n, H, D), k/v
+    (B, S/n, Hkv, D) are per-device shards, sequence-contiguous in axis
+    order.  Requires H % n == 0 and Hkv % n == 0.  Returns the local output
+    shard (B, S/n, H, D).
+    """
+    n = jax.lax.axis_size(axis_name)
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % n or h_kv % n:
+        raise ValueError(
+            f"ulysses needs heads divisible by sp: heads={h}, kv_heads={h_kv}, "
+            f"sp={n} (use ring attention for sp > head count)"
+        )
+    # seq-sharded -> head-sharded: split the head axis n ways, gather the
+    # full sequence. One fused ICI all-to-all per tensor.
+    a2a = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)
+    # full-sequence attention on H/n local heads — flash kernel eligible
+    out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    # head-sharded -> seq-sharded for the output projection
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """shard_map wrapper: global (B, S, H, D) arrays, S sharded over sp.
+
+    Batch additionally shards over the data axes and heads over tp when
+    divisible (mirroring ``ring_attention_sharded``), so only the sp
+    dimension pays the all-to-alls.
+    """
+    from mlcomp_tpu.parallel.mesh import seq_shard_spec
+
+    b, _, h, _ = q.shape
+    h_kv = k.shape[2]
+    # heads must split over BOTH tp (weight sharding) and sp (the a2a)
+    spec = seq_shard_spec(mesh, b, h, h_kv, axis_name, heads_split_sp=True)
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
