@@ -156,8 +156,9 @@ def make_hybrid_mesh(
         devices=devices,
         process_is_granule=granule_is_process,
     )
-    # arr axes are dcn-major per axis: reshape (dcn_a, ici_a) pairs -> a
-    arr = arr.reshape(tuple(sizes[a] for a in AXES))
+    # create_hybrid_device_mesh returns the element-wise product shape
+    # (dcn_a * ici_a per axis) == (sizes[a] for a in AXES), dcn-major
+    # within each axis — already the layout Mesh expects
     return Mesh(arr, AXES)
 
 

@@ -37,53 +37,176 @@ _ROUTES = [
 _DASHBOARD = """<!doctype html>
 <html><head><meta charset="utf-8"><title>mlcomp-tpu</title>
 <style>
-body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa}
-h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
-table{border-collapse:collapse;width:100%;background:#fff}
-td,th{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
-th{background:#f0f0f0}
-.success{color:#0a7d38}.failed{color:#c0262d}.in_progress{color:#b07a00}
-.not_ran,.queued{color:#777}
-pre{background:#111;color:#dedede;padding:.8rem;font-size:.75rem;overflow:auto}
+:root{color-scheme:light;
+ --surface:#fcfcfb;--panel:#ffffff;--border:#e3e2de;
+ --text:#0b0b0b;--text2:#52514e;--muted:#8a897f;
+ --series:#2a78d6;--grid:#eeede9;
+ --ok:#0a7d38;--bad:#c0262d;--warn:#9a6a00;--off:#777}
+@media (prefers-color-scheme:dark){:root{color-scheme:dark;
+ --surface:#1a1a19;--panel:#232322;--border:#3a3936;
+ --text:#ffffff;--text2:#c3c2b7;--muted:#8a897f;
+ --series:#3987e5;--grid:#31302d;
+ --ok:#3fae6d;--bad:#e66767;--warn:#c98500;--off:#999}}
+body{font-family:system-ui,sans-serif;margin:2rem;background:var(--surface);color:var(--text)}
+h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem;color:var(--text)}
+table{border-collapse:collapse;width:100%;background:var(--panel)}
+td,th{border:1px solid var(--border);padding:.35rem .6rem;font-size:.85rem;text-align:left;color:var(--text)}
+th{background:var(--surface);color:var(--text2);font-weight:600}
+a{color:var(--series)}
+.chip{display:inline-flex;align-items:center;gap:.35rem}
+.chip::before{content:'';width:.55rem;height:.55rem;border-radius:50%;background:currentColor}
+.success{color:var(--ok)}.failed{color:var(--bad)}
+.in_progress,.queued{color:var(--warn)}.not_ran,.skipped,.stopped{color:var(--off)}
+pre{background:var(--panel);border:1px solid var(--border);color:var(--text2);
+ padding:.8rem;font-size:.75rem;overflow:auto;max-height:20rem}
+.charts{display:flex;flex-wrap:wrap;gap:1rem}
+.chart{background:var(--panel);border:1px solid var(--border);border-radius:4px;padding:.6rem}
+.chart h3{margin:.1rem 0 .4rem;font-size:.85rem;font-weight:600;color:var(--text2)}
+.tip{position:fixed;pointer-events:none;background:var(--panel);border:1px solid var(--border);
+ border-radius:4px;padding:.25rem .5rem;font-size:.75rem;color:var(--text);display:none;z-index:9}
+#graph{background:var(--panel);border:1px solid var(--border);border-radius:4px}
+.node{fill:var(--panel);stroke:var(--border)}
+.nlabel{font-size:11px;fill:var(--text)}
+.edge{stroke:var(--muted);stroke-width:1.2;fill:none;marker-end:url(#arr)}
 </style></head><body>
 <h1>mlcomp-tpu report</h1>
 <h2>DAGs</h2><table id="dags"></table>
-<h2>Tasks <span id="dagsel"></span></h2><table id="tasks"></table>
+<h2>Graph <span id="dagsel"></span></h2><svg id="graph" width="100%" height="0"></svg>
+<h2>Tasks</h2><table id="tasks"></table>
 <h2>Workers</h2><table id="workers"></table>
-<h2>Logs / metrics <span id="tasksel"></span></h2><pre id="detail">select a task</pre>
+<h2>Task detail <span id="tasksel"></span></h2>
+<div id="charts" class="charts"></div>
+<pre id="detail">select a task</pre>
+<div id="tip" class="tip"></div>
 <script>
 const J=u=>fetch(u).then(r=>r.json());
-let curDag=null;
+const SVG=(t,a)=>{const e=document.createElementNS('http://www.w3.org/2000/svg',t);
+ for(const k in a)e.setAttribute(k,a[k]);return e};
+let curDag=null,curTask=null;
 function row(tr,cells,head){const r=document.createElement('tr');
  for(const c of cells){const d=document.createElement(head?'th':'td');
-  if(c instanceof Node)d.appendChild(c);else{d.textContent=c[0]??c;
-   if(Array.isArray(c)&&c[1])d.className=c[1];}r.appendChild(d);}
+  if(c instanceof Node)d.appendChild(c);else if(Array.isArray(c)){
+   d.textContent=c[0];if(c[1]){d.className=c[1]+' chip'}}
+  else d.textContent=c??'';r.appendChild(d);}
  tr.appendChild(r);}
+function link(text,fn){const a=document.createElement('a');a.href='#';
+ a.textContent=text;a.onclick=()=>{fn();return false};return a}
+
+// layered DAG graph: x = dependency depth, y = slot within layer
+function drawGraph(tasks){
+ const g=document.getElementById('graph');g.innerHTML='';
+ if(!tasks.length){g.setAttribute('height',0);return}
+ const byName={},depth={};for(const t of tasks)byName[t.name]=t;
+ const d=n=>{if(depth[n]!==undefined)return depth[n];depth[n]=0; // cycle guard
+  const deps=JSON.parse(byName[n].depends||'[]');
+  return depth[n]=deps.length?1+Math.max(...deps.map(d)):0};
+ tasks.forEach(t=>d(t.name));
+ const layers={};tasks.forEach(t=>{(layers[depth[t.name]]??=[]).push(t)});
+ const W=170,H=46,ncol=Object.keys(layers).length;
+ const nrow=Math.max(...Object.values(layers).map(l=>l.length));
+ g.setAttribute('viewBox','0 0 '+(ncol*W+20)+' '+(nrow*H+20));
+ g.setAttribute('height',Math.min(nrow*H+20,360));
+ const defs=SVG('defs',{});const mk=SVG('marker',{id:'arr',viewBox:'0 0 8 8',
+  refX:8,refY:4,markerWidth:7,markerHeight:7,orient:'auto'});
+ const tri=SVG('path',{d:'M0 0L8 4L0 8z'});tri.setAttribute('fill','var(--muted)');
+ mk.appendChild(tri);defs.appendChild(mk);g.appendChild(defs);
+ const pos={};for(const[dep,list]of Object.entries(layers))
+  list.forEach((t,i)=>pos[t.name]=[10+dep*W,10+i*H]);
+ for(const t of tasks)for(const dn of JSON.parse(t.depends||'[]')){
+  const[x1,y1]=pos[dn],[x2,y2]=pos[t.name];
+  g.appendChild(SVG('path',{class:'edge',
+   d:'M'+(x1+130)+' '+(y1+16)+' C'+(x1+155)+' '+(y1+16)+','+(x2-25)+' '+(y2+16)+','+x2+' '+(y2+16)}));}
+ for(const t of tasks){const[x,y]=pos[t.name];
+  g.appendChild(SVG('rect',{class:'node',x,y,width:130,height:32,rx:4}));
+  const cls={success:'ok',failed:'bad',in_progress:'warn',queued:'warn'}[t.status];
+  const dot=SVG('circle',{cx:x+12,cy:y+16,r:4});
+  dot.setAttribute('fill',cls?'var(--'+cls+')':'var(--off)');g.appendChild(dot);
+  const lb=SVG('text',{class:'nlabel',x:x+22,y:y+20});
+  lb.textContent=t.name.length>15?t.name.slice(0,14)+'…':t.name;
+  lb.appendChild(Object.assign(SVG('title',{}),{textContent:t.name+' — '+t.status}));
+  g.appendChild(lb);}}
+
+// single-series line chart with crosshair + tooltip; series: [[step,value]..]
+function lineChart(name,series){
+ const W=300,H=120,PL=44,PR=10,PT=8,PB=18;
+ const box=document.createElement('div');box.className='chart';
+ const h=document.createElement('h3');h.textContent=name;box.appendChild(h);
+ const svg=SVG('svg',{width:W,height:H});box.appendChild(svg);
+ const xs=series.map(p=>p[0]),ys=series.map(p=>p[1]);
+ let x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+ if(x0===x1)x1=x0+1; if(y0===y1){y0-=1;y1+=1}
+ const X=v=>PL+(v-x0)/(x1-x0)*(W-PL-PR), Y=v=>PT+(1-(v-y0)/(y1-y0))*(H-PT-PB);
+ const fmt=v=>Math.abs(v)>=100?v.toFixed(0):Math.abs(v)>=1?v.toFixed(2):v.toPrecision(3);
+ for(let i=0;i<3;i++){const yv=y0+(y1-y0)*i/2,yy=Y(yv);
+  const gl=SVG('line',{x1:PL,x2:W-PR,y1:yy,y2:yy});
+  gl.setAttribute('stroke','var(--grid)');svg.appendChild(gl);
+  const lb=SVG('text',{x:PL-4,y:yy+3,'text-anchor':'end','font-size':9});
+  lb.setAttribute('fill','var(--text2)');lb.textContent=fmt(yv);svg.appendChild(lb);}
+ const xl=SVG('text',{x:W-PR,y:H-5,'text-anchor':'end','font-size':9});
+ xl.setAttribute('fill','var(--text2)');xl.textContent='step '+x1;svg.appendChild(xl);
+ const path=SVG('path',{fill:'none','stroke-width':2,
+  d:series.map((p,i)=>(i?'L':'M')+X(p[0]).toFixed(1)+' '+Y(p[1]).toFixed(1)).join('')});
+ path.setAttribute('stroke','var(--series)');svg.appendChild(path);
+ const last=series[series.length-1];
+ const dl=SVG('text',{x:Math.min(X(last[0])+4,W-PR-28),y:Y(last[1])-5,'font-size':9});
+ dl.setAttribute('fill','var(--text2)');dl.textContent=fmt(last[1]);svg.appendChild(dl);
+ const cross=SVG('line',{y1:PT,y2:H-PB,visibility:'hidden'});
+ cross.setAttribute('stroke','var(--muted)');svg.appendChild(cross);
+ const dot=SVG('circle',{r:4,visibility:'hidden'});
+ dot.setAttribute('fill','var(--series)');dot.setAttribute('stroke','var(--panel)');
+ dot.setAttribute('stroke-width',2);svg.appendChild(dot);
+ const tip=document.getElementById('tip');
+ svg.onmousemove=e=>{const r=svg.getBoundingClientRect(),mx=e.clientX-r.left;
+  let best=0,bd=1e9;series.forEach((p,i)=>{const d=Math.abs(X(p[0])-mx);
+   if(d<bd){bd=d;best=i}});
+  const p=series[best];
+  cross.setAttribute('x1',X(p[0]));cross.setAttribute('x2',X(p[0]));
+  cross.setAttribute('visibility','visible');
+  dot.setAttribute('cx',X(p[0]));dot.setAttribute('cy',Y(p[1]));
+  dot.setAttribute('visibility','visible');
+  tip.style.display='block';tip.style.left=(e.clientX+12)+'px';
+  tip.style.top=(e.clientY-10)+'px';
+  tip.textContent=name+' @ step '+p[0]+': '+fmt(p[1])};
+ svg.onmouseleave=()=>{cross.setAttribute('visibility','hidden');
+  dot.setAttribute('visibility','hidden');tip.style.display='none'};
+ return box}
+
 async function refresh(){
  const dags=await J('/api/dags');const t=document.getElementById('dags');
  t.innerHTML='';row(t,['id','name','project','status','tasks'],true);
- for(const d of dags){const a=document.createElement('a');a.href='#';
-  a.textContent=d.id;a.onclick=()=>{curDag=d.id;refresh();return false};
-  row(t,[a,d.name,d.project,[d.status,d.status],JSON.stringify(d.counts)]);}
+ for(const d of dags)
+  row(t,[link(d.id,()=>{curDag=d.id;refresh()}),d.name,d.project,
+   [d.status,d.status],JSON.stringify(d.counts)]);
  if(curDag===null&&dags.length)curDag=dags[dags.length-1].id;
  if(curDag!==null){
   document.getElementById('dagsel').textContent='(dag '+curDag+')';
   const tasks=await J('/api/dags/'+curDag+'/tasks');
+  drawGraph(tasks);
   const tt=document.getElementById('tasks');tt.innerHTML='';
   row(tt,['id','name','executor','stage','status','worker','error'],true);
-  for(const x of tasks){const a=document.createElement('a');a.href='#';
-   a.textContent=x.id;a.onclick=()=>{showTask(x.id);return false};
-   row(tt,[a,x.name,x.executor,x.stage,[x.status,x.status],x.worker||'',x.error||'']);}}
+  for(const x of tasks)
+   row(tt,[link(x.id,()=>showTask(x.id)),x.name,x.executor,x.stage,
+    [x.status,x.status],x.worker||'',x.error||'']);}
  const ws=await J('/api/workers');const wt=document.getElementById('workers');
  wt.innerHTML='';row(wt,['name','chips','busy','status','heartbeat'],true);
- for(const w of ws)row(wt,[w.name,w.chips,w.busy_chips,[w.status,w.status==='alive'?'success':'failed'],
+ for(const w of ws)row(wt,[w.name,w.chips,w.busy_chips,
+  [w.status,w.status==='alive'?'success':'failed'],
   new Date(w.heartbeat*1000).toLocaleTimeString()]);
+ // skip the detail rebuild while the user is hovering a chart
+ if(curTask!==null&&document.getElementById('tip').style.display!=='block')
+  showTask(curTask);
 }
 async function showTask(id){
+ curTask=id;
  document.getElementById('tasksel').textContent='(task '+id+')';
- const names=await J('/api/tasks/'+id+'/metrics');let out='';
- for(const n of names){const s=await J('/api/tasks/'+id+'/metrics/'+n);
-  out+='metric '+n+': '+s.map(p=>p[1].toFixed?p[1].toFixed(4):p[1]).join(' ')+'\\n';}
+ const names=await J('/api/tasks/'+id+'/metrics');
+ const series=await Promise.all(
+  names.map(n=>J('/api/tasks/'+id+'/metrics/'+n)));
+ const ch=document.getElementById('charts');ch.innerHTML='';
+ let out='';
+ names.forEach((n,i)=>{const s=series[i];
+  if(s.length>1)ch.appendChild(lineChart(n,s));
+  if(s.length)out+='metric '+n+' (last): '+s[s.length-1][1]+'\\n'});
  const logs=await J('/api/tasks/'+id+'/logs');
  for(const l of logs)out+='['+l.level+'] '+l.message+'\\n';
  document.getElementById('detail').textContent=out||'(empty)';

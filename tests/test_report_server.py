@@ -79,3 +79,12 @@ def test_api_404(served):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _get(port, "/api/nothing")
     assert ei.value.code == 404
+
+
+def test_dashboard_ships_charts_and_graph(served):
+    """The dashboard page carries the metric-chart and DAG-graph machinery."""
+    *_, port = served
+    _, body = _get(port, "/")
+    html = body.decode()
+    for needle in ("lineChart", "drawGraph", "prefers-color-scheme"):
+        assert needle in html, needle
