@@ -34,11 +34,36 @@ def _cmd_dag(args: argparse.Namespace) -> int:
     return 1 if bad else 0
 
 
+def _cmd_submit(args: argparse.Namespace) -> int:
+    from pathlib import Path
+
+    from mlcomp_tpu.dag import parse_dag
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.io.sync import inject_code_sync
+
+    dag = parse_dag(args.config)
+    dag = inject_code_sync(dag, base_dir=Path(args.config).parent)
+    store = Store(args.db)
+    dag_id = store.submit_dag(dag)
+    store.close()
+    print(
+        json.dumps(
+            {"dag_id": dag_id, "name": dag.name, "tasks": len(dag.tasks)}
+        )
+    )
+    return 0
+
+
 def _cmd_supervisor(args: argparse.Namespace) -> int:
     from mlcomp_tpu.scheduler.supervisor import Supervisor
     from mlcomp_tpu.db.store import Store
 
-    sup = Supervisor(Store(args.db))
+    notifiers = None
+    if args.notify:
+        import yaml
+
+        notifiers = [yaml.safe_load(n) for n in args.notify]
+    sup = Supervisor(Store(args.db), notifiers=notifiers)
     sup.run_forever(poll_interval=args.poll)
     return 0
 
@@ -72,9 +97,20 @@ def main(argv=None) -> int:
     d.add_argument("--workers", type=int, default=1)
     d.set_defaults(fn=_cmd_dag)
 
+    sb = sub.add_parser("submit", help="submit a DAG to the queue (daemons run it)")
+    sb.add_argument("config")
+    sb.add_argument("--db", default="mlcomp.sqlite")
+    sb.set_defaults(fn=_cmd_submit)
+
     s = sub.add_parser("supervisor", help="run the supervisor daemon")
     s.add_argument("--db", default="mlcomp.sqlite")
     s.add_argument("--poll", type=float, default=1.0)
+    s.add_argument(
+        "--notify",
+        action="append",
+        metavar="YAML",
+        help='notifier spec, e.g. \'{type: file, path: events.jsonl}\' (repeatable)',
+    )
     s.set_defaults(fn=_cmd_supervisor)
 
     w = sub.add_parser("worker", help="run a worker daemon")

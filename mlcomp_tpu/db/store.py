@@ -145,9 +145,23 @@ class Store:
             raise KeyError(f"no dag {dag_id}")
         return row["status"]
 
-    def set_dag_status(self, dag_id: int, status: str) -> None:
+    def set_dag_status(
+        self, dag_id: int, status: str, expect: Optional[str] = None
+    ) -> bool:
+        """Set a dag's status; with ``expect`` the update is conditional
+        (compare-and-set) and the return says whether THIS call made the
+        transition — the once-only hook point for notifications."""
         with self._tx() as c:
-            c.execute("UPDATE dags SET status=? WHERE id=?", (status, dag_id))
+            if expect is None:
+                cur = c.execute(
+                    "UPDATE dags SET status=? WHERE id=?", (status, dag_id)
+                )
+            else:
+                cur = c.execute(
+                    "UPDATE dags SET status=? WHERE id=? AND status=?",
+                    (status, dag_id, expect),
+                )
+            return cur.rowcount > 0
 
     def list_dags(self) -> List[Dict[str, Any]]:
         rows = self._conn.execute(

@@ -35,6 +35,12 @@ class TrainExecutor(Executor):
         dag_name = cfg.pop("dag_name", f"dag{ctx.dag_id}")
         ckpt_dir = storage.checkpoint_dir(project, dag_name, ctx.task_name)
 
+        # trace: true → spans land next to the checkpoints
+        if cfg.get("trace") and not (
+            isinstance(cfg["trace"], dict) and "path" in cfg["trace"]
+        ):
+            cfg["trace"] = {"path": str(Path(ckpt_dir) / "trace.json")}
+
         trainer = Trainer(cfg)
         ctx.log(
             f"model={cfg['model'].get('name')} params={trainer.n_params:,} "
@@ -58,6 +64,8 @@ class TrainExecutor(Executor):
                 save_checkpoint(ckpt_dir, trainer.state, step=int(trainer.state.step))
 
         final = trainer.fit(on_epoch=on_epoch)
+        if trainer.trace_path:
+            ctx.log(f"trace written to {trainer.trace_path}")
         cur = int(trainer.state.step)
         if latest_step(ckpt_dir) != cur:  # avoid re-saving the epoch save
             save_checkpoint(ckpt_dir, trainer.state, step=cur)

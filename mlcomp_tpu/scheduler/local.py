@@ -32,7 +32,13 @@ def run_dag_local(
     overrides: Optional[Mapping] = None,
 ) -> Dict[str, TaskStatus]:
     """Parse, submit, and run a DAG to completion; returns task statuses."""
+    from mlcomp_tpu.io.sync import inject_code_sync
+
     dag = parse_dag(source, overrides=overrides)
+    base = Path(source).parent if isinstance(source, (str, Path)) and Path(
+        str(source)
+    ).exists() else Path(".")
+    dag = inject_code_sync(dag, base_dir=base)
     if chips is None:
         chips = _local_chip_count(dag)
     if db_path is None:

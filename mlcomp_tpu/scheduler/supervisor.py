@@ -25,9 +25,34 @@ from mlcomp_tpu.db.store import Store
 
 
 class Supervisor:
-    def __init__(self, store: Store, worker_timeout_s: float = 30.0):
+    def __init__(
+        self,
+        store: Store,
+        worker_timeout_s: float = 30.0,
+        notifiers=None,
+    ):
         self.store = store
         self.worker_timeout_s = worker_timeout_s
+        # [{type: file|command|webhook, ...}] or pre-built Notifier objects
+        from mlcomp_tpu.utils.notify import create_notifiers
+
+        self.notifiers = (
+            create_notifiers(notifiers)
+            if notifiers and isinstance(notifiers[0], dict)
+            else list(notifiers or [])
+        )
+
+    def _notify(self, event: str, **detail) -> None:
+        import logging
+
+        from mlcomp_tpu.utils.notify import notify_all
+
+        notify_all(
+            self.notifiers,
+            event,
+            on_error=logging.getLogger("mlcomp_tpu.supervisor").warning,
+            **detail,
+        )
 
     def tick(self) -> Dict[int, str]:
         """One scheduling pass over all live DAGs; returns dag_id → status."""
@@ -67,7 +92,15 @@ class Supervisor:
                 if all(s == TaskStatus.SUCCESS for s in statuses.values())
                 else "failed"
             )
-            self.store.set_dag_status(dag_id, final)
+            # set_dag_status returns True only for the replica that made
+            # the transition, so multi-supervisor setups notify once
+            if self.store.set_dag_status(dag_id, final, expect="in_progress"):
+                self._notify(
+                    "dag_finished",
+                    dag_id=dag_id,
+                    status=final,
+                    tasks={n: s.value for n, s in statuses.items()},
+                )
             return final
         return "in_progress"
 
@@ -81,7 +114,15 @@ class Supervisor:
                         TaskStatus.FAILED,
                         error=f"worker {name!r} died and retries exhausted",
                     )
+                    self._notify(
+                        "task_failed",
+                        task_id=task["id"],
+                        task=task["name"],
+                        dag_id=task["dag_id"],
+                        error=f"worker {name!r} died and retries exhausted",
+                    )
             self.store.mark_worker_dead(name)
+            self._notify("worker_dead", worker=name)
 
     def run_forever(self, poll_interval: float = 1.0) -> None:
         while True:

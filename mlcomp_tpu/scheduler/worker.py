@@ -60,6 +60,36 @@ class Worker:
         finally:
             hb_store.close()
 
+    def _sync_code(self, args: Dict[str, Any], task_id: int) -> None:
+        """Mirror the master's code snapshot (``args["code_src"]``, written
+        by ``io.sync.snapshot_code`` at submit time) into this worker's
+        workdir and make it importable — the reference family's
+        master→worker project sync, hash-incremental here."""
+        code_src = args.get("code_src")
+        if not code_src:
+            return
+        import sys
+
+        from mlcomp_tpu.io.sync import sync_dirs
+
+        dest = os.path.join(self.workdir, "code")
+        copied, removed = sync_dirs(code_src, dest)
+        if copied or removed:
+            self.store.log(
+                task_id,
+                "info",
+                f"code sync: {len(copied)} copied, {len(removed)} removed",
+            )
+        if dest not in sys.path:
+            sys.path.insert(0, dest)
+        # import user modules so their @EXECUTORS.register classes exist;
+        # re-import after a changed sync would need a restart (same rule as
+        # the reference's worker: code changes mid-task are not hot-swapped)
+        import importlib
+
+        for mod in args.get("code_import", []):
+            importlib.import_module(mod)
+
     def run_once(self) -> bool:
         """Claim and execute at most one task. Returns True if one ran."""
         self.store.heartbeat(self.name, self.chips)
@@ -75,17 +105,27 @@ class Worker:
         )
         pump.start()
         try:
-            ctx = ExecutionContext(
-                dag_id=claim["dag_id"],
-                task_id=claim["id"],
-                task_name=claim["name"],
-                args=json.loads(claim["args"]),
-                store=self.store,
-                workdir=self.workdir,
-                chips=claim["chips"],
-                stage=claim["stage"],
-            )
-            ok, result, err = run_task(claim["executor"], ctx)
+            # pre-execution setup failures (bad args JSON, code sync/import
+            # errors) must fail THE TASK, not kill the worker loop
+            try:
+                args = json.loads(claim["args"])
+                self._sync_code(args, claim["id"])
+            except Exception:
+                import traceback
+
+                ok, result, err = False, None, traceback.format_exc()
+            else:
+                ctx = ExecutionContext(
+                    dag_id=claim["dag_id"],
+                    task_id=claim["id"],
+                    task_name=claim["name"],
+                    args=args,
+                    store=self.store,
+                    workdir=self.workdir,
+                    chips=claim["chips"],
+                    stage=claim["stage"],
+                )
+                ok, result, err = run_task(claim["executor"], ctx)
         finally:
             stop.set()
             pump.join(timeout=self.heartbeat_interval_s + 1.0)

@@ -31,6 +31,7 @@ from mlcomp_tpu.train.losses import create_loss
 from mlcomp_tpu.train.metrics import create_metrics
 from mlcomp_tpu.train.optim import create_optimizer
 from mlcomp_tpu.train.state import TrainState, init_model, param_count
+from mlcomp_tpu.utils.trace import Tracer, get_tracer, set_tracer
 
 
 def make_train_step(
@@ -114,6 +115,17 @@ class Trainer:
 
         set_current_mesh(self.mesh)
 
+        # host-side span tracing: cfg trace: true | {path: out.json}.
+        # The tracer is PER-TRAINER state; it is only installed globally
+        # (for model-internal call sites) for the duration of fit().
+        trace_cfg = cfg.get("trace")
+        self.tracer: Optional[Tracer] = None
+        self.trace_path: Optional[str] = None
+        if trace_cfg:
+            tc = trace_cfg if isinstance(trace_cfg, dict) else {}
+            self.trace_path = tc.get("path", "trace.json")
+            self.tracer = Tracer(self.trace_path)
+
         datasets = cfg.get("data", {})
         self.loaders: Dict[str, DataLoader] = {}
         for split, dcfg in datasets.items():
@@ -177,8 +189,18 @@ class Trainer:
     def train_epoch(self) -> Dict[str, float]:
         agg: Dict[str, Any] = {}
         n = 0
-        for batch in self._loader("train"):
-            self.state, stats = self._train_step(self.state, batch)
+        tracer = self.tracer if self.tracer is not None else get_tracer()
+        it = iter(self._loader("train"))
+        while True:
+            # separate data/step spans: a fat "data" track means the input
+            # pipeline starves the chips; a fat "step" means the host
+            # blocked on dispatch (device queue full)
+            with tracer.span("data", split="train"):
+                batch = next(it, None)
+            if batch is None:
+                break
+            with tracer.span("step", n=n):
+                self.state, stats = self._train_step(self.state, batch)
             for k, v in stats.items():
                 agg[k] = agg.get(k, 0.0) + v  # device-side accumulation
             n += 1
@@ -201,18 +223,33 @@ class Trainer:
         already completed k epochs (by step count) runs only the remainder,
         and epoch numbers continue from k so metric series don't overlap."""
         last: Dict[str, float] = {}
-        for epoch in range(self.epochs_done, self.epochs):
-            t0 = time.perf_counter()
-            train_stats = self.train_epoch()
-            stats = {f"train/{k}": v for k, v in train_stats.items()}
-            if "valid" in self.loaders:
-                stats.update(
-                    {f"valid/{k}": v for k, v in self.eval_epoch("valid").items()}
-                )
-            stats["epoch_time_s"] = time.perf_counter() - t0
-            if on_epoch is not None:
-                on_epoch(epoch, stats)
-            last = stats
+        tracer = self.tracer if self.tracer is not None else get_tracer()
+        if self.tracer is not None:
+            set_tracer(self.tracer)  # visible to model-internal spans
+        try:
+            for epoch in range(self.epochs_done, self.epochs):
+                t0 = time.perf_counter()
+                with tracer.span("train_epoch", epoch=epoch):
+                    train_stats = self.train_epoch()
+                stats = {f"train/{k}": v for k, v in train_stats.items()}
+                if "valid" in self.loaders:
+                    with tracer.span("eval_epoch", epoch=epoch):
+                        stats.update(
+                            {
+                                f"valid/{k}": v
+                                for k, v in self.eval_epoch("valid").items()
+                            }
+                        )
+                stats["epoch_time_s"] = time.perf_counter() - t0
+                tracer.counter("loss", {"train": stats.get("train/loss", 0.0)})
+                if on_epoch is not None:
+                    on_epoch(epoch, stats)
+                last = stats
+        finally:
+            if self.tracer is not None:
+                set_tracer(None)
+        if self.trace_path and self.tracer is not None:
+            self.tracer.save(self.trace_path)
         return last
 
     def predict(self, split: str = "infer") -> np.ndarray:

@@ -1,0 +1,167 @@
+"""Tracing: host-side span tracer + device profiler hooks.
+
+The reference has no tracing subsystem (task logs in the DB are its only
+observability); this module gives the TPU build two layers the reference
+lacks:
+
+- ``Tracer`` — a lightweight host-side span recorder (wall-clock, thread
+  aware) that serializes to Chrome trace-event JSON, viewable in
+  ``chrome://tracing`` / Perfetto.  The Trainer wraps epochs, data loading
+  and step dispatch in spans when ``cfg["trace"]`` is set; executors can
+  add their own via ``get_tracer()``.
+- ``device_profile`` — a context manager around ``jax.profiler`` tracing,
+  producing a TensorBoard-loadable device profile (XLA op timeline, HBM
+  usage) for the hot path.  Host spans tell you WHERE time goes between
+  steps; the device profile tells you where it goes inside one.
+
+Host spans deliberately measure *dispatch* time under JAX's async
+execution: a long ``step`` span means the host blocked (queue full, sync
+fetch) — itself a signal.  Use ``device_profile`` for on-chip truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+class Tracer:
+    """Span recorder emitting Chrome trace-event format.
+
+    Thread-safe: spans carry the recording thread's id, so worker threads
+    (data prefetch, heartbeat) show as separate tracks.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextmanager
+    def span(self, name: str, **args):
+        start = self._now_us()
+        try:
+            yield self
+        finally:
+            end = self._now_us()
+            with self._lock:
+                self._events.append(
+                    {
+                        "name": name,
+                        "ph": "X",
+                        "ts": start,
+                        "dur": end - start,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident(),
+                        "args": args,
+                    }
+                )
+
+    def instant(self, name: str, **args) -> None:
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "ph": "i",
+                    "ts": self._now_us(),
+                    "s": "t",
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident(),
+                    "args": args,
+                }
+            )
+
+    def counter(self, name: str, values: Dict[str, float]) -> None:
+        """Counter track (e.g. loss over time) rendered as a graph."""
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "ts": self._now_us(),
+                    "pid": os.getpid(),
+                    "args": {k: float(v) for k, v in values.items()},
+                }
+            )
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def save(self, path: Optional[str] = None) -> str:
+        """Write Chrome trace JSON; returns the path written."""
+        path = path or self.path
+        if not path:
+            raise ValueError("no trace path configured")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with self._lock:
+            body = {"traceEvents": self._events, "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(body, f)
+        return path
+
+
+class _NullTracer(Tracer):
+    """No-op recorder so call sites never need an `if tracer:` guard."""
+
+    def __init__(self):
+        super().__init__()
+
+    @contextmanager
+    def span(self, name: str, **args):
+        yield self
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def counter(self, name: str, values: Dict[str, float]) -> None:
+        pass
+
+    def save(self, path: Optional[str] = None) -> str:
+        raise ValueError("null tracer has nothing to save")
+
+
+_NULL = _NullTracer()
+_current: List[Tracer] = []
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    """Install the process-wide tracer (Trainer does this); None clears."""
+    _current.clear()
+    if tracer is not None:
+        _current.append(tracer)
+
+
+def get_tracer() -> Tracer:
+    """The installed tracer, or a no-op one."""
+    return _current[0] if _current else _NULL
+
+
+@contextmanager
+def device_profile(log_dir: str, host_tracer_level: int = 2):
+    """Capture a JAX/XLA device profile into ``log_dir`` (TensorBoard
+    'profile' plugin format: op timeline, HBM, roofline)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir, host_tracer_level=host_tracer_level)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region visible in the device profile's host track — use around
+    code inside a profiled section (cheap; no-op outside profiling)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,64 @@
+"""Notification sinks + supervisor lifecycle events."""
+
+import json
+
+from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.scheduler.supervisor import Supervisor
+from mlcomp_tpu.utils.notify import (
+    FileNotifier,
+    create_notifiers,
+    notify_all,
+)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f]
+
+
+def test_file_notifier_appends_jsonl(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    n = FileNotifier(p)
+    n.send({"event": "a"})
+    n.send({"event": "b"})
+    assert [e["event"] for e in _events(p)] == ["a", "b"]
+
+
+def test_command_notifier_pipes_json(tmp_path):
+    out = tmp_path / "cmd.json"
+    ns = create_notifiers([{"type": "command", "cmd": f"cat > {out}"}])
+    notify_all(ns, "task_failed", task="t1")
+    got = json.loads(out.read_text())
+    assert got["event"] == "task_failed" and got["task"] == "t1"
+
+
+def test_notify_all_survives_failing_sink(tmp_path):
+    p = str(tmp_path / "ok.jsonl")
+    errors = []
+    ns = create_notifiers(
+        [
+            {"type": "command", "cmd": "exit 3"},  # always fails
+            {"type": "file", "path": p},
+        ]
+    )
+    notify_all(ns, "dag_finished", dag_id=1, on_error=errors.append)
+    assert len(_events(p)) == 1  # healthy sink still fired
+    assert len(errors) == 1 and "failed" in errors[0]
+
+
+def test_supervisor_notifies_dag_finished_once(tmp_db, tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="a", executor="noop"),))
+    )
+    sup = Supervisor(store, notifiers=[{"type": "file", "path": p}])
+    sup.tick()  # queues the task; dag still in progress
+    store.set_task_status(dag_id, ["a"], TaskStatus.SUCCESS)
+    sup.tick()  # finalizes + notifies
+    sup.tick()  # must not notify again (status already terminal)
+    evs = [e for e in _events(p) if e["event"] == "dag_finished"]
+    assert len(evs) == 1
+    assert evs[0]["status"] == "success" and evs[0]["tasks"] == {"a": "success"}
+    store.close()

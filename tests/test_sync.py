@@ -1,0 +1,184 @@
+"""Content-hash directory sync (master→worker code distribution)."""
+
+import sys
+
+from mlcomp_tpu.io.sync import dir_manifest, snapshot_code, sync_dirs
+
+
+def _mk(root, files):
+    for rel, content in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+
+
+def test_manifest_hashes_and_excludes(tmp_path):
+    _mk(
+        tmp_path,
+        {
+            "pkg/mod.py": "x = 1",
+            "pkg/__pycache__/mod.cpython-311.pyc": "junk",
+            ".git/HEAD": "ref",
+            "data.txt": "hello",
+        },
+    )
+    m = dir_manifest(tmp_path)
+    assert set(m) == {"pkg/mod.py", "data.txt"}
+    m2 = dir_manifest(tmp_path)
+    assert m == m2  # deterministic
+
+
+def test_sync_copies_changes_and_deletes_stale(tmp_path):
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    _mk(src, {"a.py": "1", "sub/b.py": "2"})
+    copied, removed = sync_dirs(src, dst)
+    assert copied == ["a.py", "sub/b.py"] and removed == []
+    assert (dst / "sub/b.py").read_text() == "2"
+
+    # no-op second pass
+    assert sync_dirs(src, dst) == ([], [])
+
+    # change one, delete one, add one
+    _mk(src, {"a.py": "1-changed", "c.py": "3"})
+    (src / "sub/b.py").unlink()
+    copied, removed = sync_dirs(src, dst)
+    assert copied == ["a.py", "c.py"] and removed == ["sub/b.py"]
+    assert not (dst / "sub").exists()  # empty dirs pruned
+
+
+def test_snapshot_code_roundtrip(tmp_path):
+    proj = tmp_path / "proj"
+    _mk(proj, {"exec.py": "print('hi')"})
+    snap = snapshot_code(proj, tmp_path / "storage", "myproj")
+    assert snap.endswith("code/myproj")
+    m = dir_manifest(snap)
+    assert set(m) == {"exec.py"}
+
+
+def test_worker_sync_makes_code_importable(tmp_db, tmp_path):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.scheduler.worker import Worker
+
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="a", executor="noop"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+
+    src = tmp_path / "snap"
+    _mk(src, {"user_mod_sync_test.py": "MAGIC = 41"})
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    w = Worker(store, name="w0", workdir=str(workdir), load_jax_executors=False)
+    dest = str(workdir / "code")
+    try:
+        w._sync_code({"code_src": str(src)}, tid)
+        assert (workdir / "code/user_mod_sync_test.py").exists()
+        assert dest in sys.path
+        import user_mod_sync_test
+
+        assert user_mod_sync_test.MAGIC == 41
+        logs = " ".join(l["message"] for l in store.task_logs(tid))
+        assert "code sync: 1 copied" in logs
+    finally:
+        sys.path.remove(dest)
+        sys.modules.pop("user_mod_sync_test", None)
+        store.close()
+
+
+def test_dag_with_code_dir_runs_user_executor(tmp_db, tmp_path):
+    """End-to-end: info.code_dir ships a user-defined executor to workers."""
+    import sys
+
+    from mlcomp_tpu.dag.schema import TaskStatus
+    from mlcomp_tpu.scheduler.local import run_dag_local
+
+    proj = tmp_path / "proj"
+    _mk(
+        proj,
+        {
+            "my_executors.py": (
+                "from mlcomp_tpu.executors.base import Executor\n"
+                "class Hello(Executor):\n"
+                "    name = 'hello_from_user_code'\n"
+                "    def work(self, ctx):\n"
+                "        ctx.log('user code ran')\n"
+                "        return {'answer': 42}\n"
+            )
+        },
+    )
+    cfg = {
+        "info": {
+            "name": "usercode",
+            "project": "p",
+            "code_dir": str(proj),
+            "code_import": "my_executors",
+            "storage_root": str(tmp_path / "storage"),
+        },
+        "executors": {"hello": {"type": "hello_from_user_code"}},
+    }
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    dest = str(workdir / "code")
+    try:
+        statuses = run_dag_local(cfg, db_path=tmp_db, workdir=str(workdir))
+        assert statuses == {"hello": TaskStatus.SUCCESS}
+        import json as _json
+
+        from mlcomp_tpu.db.store import Store
+
+        store = Store(tmp_db)
+        row = store.task_rows(1)[0]
+        assert _json.loads(row["result"]) == {"answer": 42}
+        logs = " ".join(l["message"] for l in store.task_logs(row["id"]))
+        assert "user code ran" in logs
+        store.close()
+    finally:
+        if dest in sys.path:
+            sys.path.remove(dest)
+        sys.modules.pop("my_executors", None)
+
+
+def test_sync_missing_src_raises_not_wipes(tmp_path):
+    import pytest
+
+    dst = tmp_path / "dst"
+    _mk(dst, {"warm.py": "x"})
+    with pytest.raises(FileNotFoundError):
+        sync_dirs(tmp_path / "nope", dst)
+    assert (dst / "warm.py").exists()  # warm copy preserved
+
+
+def test_bad_code_import_fails_task_not_worker(tmp_db, tmp_path):
+    """Setup errors (typo'd code_import) fail the task; the worker survives."""
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.scheduler.supervisor import Supervisor
+    from mlcomp_tpu.scheduler.worker import Worker
+
+    proj = tmp_path / "proj"
+    _mk(proj, {"ok.py": "pass"})
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(
+            name="d",
+            project="p",
+            tasks=(
+                TaskSpec(
+                    name="a",
+                    executor="noop",
+                    args={"code_src": str(proj), "code_import": ["no_such_module"]},
+                ),
+            ),
+        )
+    )
+    sup = Supervisor(store)
+    sup.tick()
+    w = Worker(store, name="w0", workdir=str(tmp_path / "wk"), load_jax_executors=False)
+    assert w.run_once() is True  # ran (and failed) the task; did not raise
+    sup.tick()
+    assert store.task_statuses(dag_id)["a"] == TaskStatus.FAILED
+    row = store.task_rows(dag_id)[0]
+    assert "no_such_module" in row["error"]
+    store.close()

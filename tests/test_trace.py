@@ -1,0 +1,77 @@
+"""Span tracer: Chrome trace output + Trainer integration."""
+
+import json
+
+import pytest
+
+from mlcomp_tpu.utils.trace import Tracer, get_tracer, set_tracer
+
+
+def test_spans_and_counters_roundtrip(tmp_path):
+    path = str(tmp_path / "t.json")
+    tr = Tracer(path)
+    with tr.span("outer", epoch=0):
+        with tr.span("inner"):
+            pass
+        tr.instant("marker", note="hi")
+    tr.counter("loss", {"train": 1.5})
+    out = tr.save()
+    body = json.loads(open(out).read())
+    evs = body["traceEvents"]
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["outer"]["ph"] == "X" and by_name["outer"]["dur"] >= 0
+    assert by_name["outer"]["args"] == {"epoch": 0}
+    assert by_name["inner"]["ts"] >= by_name["outer"]["ts"]
+    assert by_name["marker"]["ph"] == "i"
+    assert by_name["loss"]["ph"] == "C"
+    assert by_name["loss"]["args"] == {"train": 1.5}
+
+
+def test_null_tracer_is_silent():
+    set_tracer(None)
+    t = get_tracer()
+    with t.span("x"):
+        t.instant("y")
+        t.counter("z", {"a": 1})
+    with pytest.raises(ValueError):
+        t.save()
+
+
+def test_set_get_tracer():
+    tr = Tracer()
+    set_tracer(tr)
+    assert get_tracer() is tr
+    set_tracer(None)
+    assert get_tracer() is not tr
+
+
+def test_trainer_writes_trace(tmp_path):
+    from mlcomp_tpu.train.loop import Trainer
+
+    path = str(tmp_path / "train_trace.json")
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 4},
+        "optimizer": {"name": "sgd", "lr": 0.1},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "epochs": 2,
+        "seed": 0,
+        "trace": {"path": path},
+        "data": {
+            "train": {
+                "name": "synthetic_classification",
+                "n": 16,
+                "dim": 6,
+                "num_classes": 4,
+                "batch_size": 8,
+            }
+        },
+    }
+    trainer = Trainer(cfg)
+    trainer.fit()
+    set_tracer(None)
+    evs = json.loads(open(path).read())["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"train_epoch", "data", "step", "loss"} <= names
+    epochs = [e["args"]["epoch"] for e in evs if e["name"] == "train_epoch"]
+    assert epochs == [0, 1]
