@@ -64,14 +64,18 @@ def main() -> None:
         donate_argnums=(0,),
     )
 
+    # NOTE: sync via an actual device->host fetch of the step's loss, not
+    # jax.block_until_ready — on the tunneled `axon` TPU backend
+    # block_until_ready returns before execution finishes, which inflated
+    # throughput ~40x.  float(...) forces a real round-trip.
     for _ in range(WARMUP):
         state, stats = step(state, batch)
-    jax.block_until_ready(state.params)
+    float(stats["loss"])
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
         state, stats = step(state, batch)
-    jax.block_until_ready(state.params)
+    float(stats["loss"])
     dt = time.perf_counter() - t0
 
     images_per_sec = BATCH * STEPS / dt
