@@ -70,6 +70,15 @@ CREATE TABLE IF NOT EXISTS metrics (
     value   REAL NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_metrics_task ON metrics (task_id, name, step);
+CREATE TABLE IF NOT EXISTS reports (
+    id      INTEGER PRIMARY KEY AUTOINCREMENT,
+    task_id INTEGER NOT NULL,
+    ts      REAL NOT NULL,
+    name    TEXT NOT NULL,
+    kind    TEXT NOT NULL,
+    payload TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_reports_task ON reports (task_id);
 CREATE TABLE IF NOT EXISTS workers (
     name      TEXT PRIMARY KEY,
     chips     INTEGER NOT NULL DEFAULT 0,
@@ -344,6 +353,38 @@ class Store:
             (task_id,),
         ).fetchall()
         return [r["name"] for r in rows]
+
+    # --------------------------------------------------------------- reports
+
+    def add_report(self, task_id: int, name: str, payload: Dict[str, Any]) -> int:
+        """Persist a report artifact (classification/segmentation/... payload
+        from report/artifacts.py); ``kind`` is read off the payload."""
+        with self._tx() as c:
+            cur = c.execute(
+                "INSERT INTO reports (task_id, ts, name, kind, payload)"
+                " VALUES (?,?,?,?,?)",
+                (
+                    task_id,
+                    time.time(),
+                    name,
+                    str(payload.get("kind", "generic")),
+                    json.dumps(payload),
+                ),
+            )
+            return int(cur.lastrowid)
+
+    def reports(self, task_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            "SELECT id, ts, name, kind FROM reports WHERE task_id=? ORDER BY id",
+            (task_id,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def report_payload(self, report_id: int) -> Optional[Dict[str, Any]]:
+        row = self._conn.execute(
+            "SELECT payload FROM reports WHERE id=?", (report_id,)
+        ).fetchone()
+        return json.loads(row["payload"]) if row else None
 
     # --------------------------------------------------------------- workers
 

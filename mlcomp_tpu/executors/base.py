@@ -42,6 +42,11 @@ class ExecutionContext:
         if self.store is not None:
             self.store.metric(self.task_id, name, value, step)
 
+    def report(self, name: str, payload: Dict[str, Any]) -> None:
+        """Persist a report artifact (report/artifacts.py payload)."""
+        if self.store is not None:
+            self.store.add_report(self.task_id, name, payload)
+
 
 class Executor:
     """Base executor: subclass, set ``name``, implement ``work()``.

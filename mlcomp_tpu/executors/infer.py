@@ -70,6 +70,7 @@ class ValidExecutor(Executor):
         from mlcomp_tpu.train.loop import Trainer
 
         cfg = dict(self.args)
+        report_cfg = cfg.pop("report", None)
         trainer = Trainer(cfg)
         ckpt_dir = _find_ckpt_dir(ctx, cfg)
         if ckpt_dir:
@@ -79,8 +80,52 @@ class ValidExecutor(Executor):
             ctx.log(
                 "no checkpoint found; validating fresh params", level="warning"
             )
-        stats = trainer.eval_epoch("valid")
+        stats = None
+        if report_cfg:
+            # reports are auxiliary: never fail a valid task over a
+            # malformed report option — fall back to the plain eval pass
+            try:
+                stats = self._valid_with_report(ctx, trainer, report_cfg)
+            except Exception as e:
+                ctx.log(f"report generation failed: {e!r}", level="error")
+        if stats is None:
+            stats = trainer.eval_epoch("valid")
         for k, v in stats.items():
             ctx.metric(f"valid/{k}", v)
         ctx.log("valid: " + " ".join(f"{k}={v:.4f}" for k, v in sorted(stats.items())))
         return {k: float(v) for k, v in stats.items()}
+
+    @staticmethod
+    def _valid_with_report(
+        ctx: ExecutionContext, trainer, report_cfg: Any
+    ) -> Dict[str, float]:
+        """One forward pass serves both the report payload and the scalar
+        metrics (losses/metrics are pure ``(outputs, batch)`` fns, so they
+        evaluate on the collected outputs — no second device pass)."""
+        from mlcomp_tpu.report.artifacts import (
+            classification_report,
+            segmentation_report,
+        )
+
+        rc = report_cfg if isinstance(report_cfg, dict) else {}
+        # labels come from the same batches as the predictions, so the
+        # pairing holds even if the valid split is configured shuffled
+        preds, y_true = trainer.predict("valid", return_labels=True)
+        if y_true is None:
+            raise ValueError("valid split has no labels")
+        kind = rc.get("kind") or ("segmentation" if preds.ndim >= 3 else "classification")
+        names = rc.get("classes")
+        if kind == "segmentation":
+            payload = segmentation_report(y_true, preds, class_names=names)
+        else:
+            payload = classification_report(
+                y_true, preds, class_names=names,
+                top_worst=int(rc.get("top_worst", 16)),
+            )
+        ctx.report(rc.get("name", f"{ctx.task_name}_{kind}"), payload)
+        ctx.log(f"report: {kind} over {payload.get('n', payload.get('n_pixels'))} samples")
+        batch = {"y": y_true}
+        stats = {"loss": float(trainer.loss_fn(preds, batch))}
+        for name, fn in trainer.metric_fns.items():
+            stats[name] = float(fn(preds, batch))
+        return stats

@@ -1,0 +1,220 @@
+"""Report artifacts: the analytical payloads behind the dashboard's plots.
+
+Upstream mlcomp's report system renders precision/recall curves, confusion
+matrices, and per-image classification/segmentation galleries in its web UI
+(upstream feature set; the reference checkout was never readable — see
+SURVEY.md provenance note).  This module computes those payloads as plain
+JSON-able dicts from device-fetched predictions:
+
+- ``classification_report``: accuracy, per-class precision/recall/F1,
+  confusion matrix, one-vs-rest PR curves, and the worst-predicted samples
+  (the UI gallery's backing data — sample index + truth + prediction +
+  confidence, which is what the upstream image gallery keys on).
+- ``segmentation_report``: pixel accuracy, per-class IoU/dice, pixel
+  confusion matrix.
+
+Everything is numpy on host — these run once per valid/infer task on
+already-fetched outputs, never inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _as_labels(y: np.ndarray) -> np.ndarray:
+    """Accept class indices or one-hot/probability rows."""
+    y = np.asarray(y)
+    return y.argmax(axis=-1) if y.ndim > 1 else y.astype(np.int64)
+
+
+def _names(class_names: Optional[Sequence[str]], num_classes: int) -> List[str]:
+    """Class labels padded to ``num_classes`` — a short user-supplied list
+    must not crash the report, it just leaves the tail classes numbered."""
+    names = [str(n) for n in class_names] if class_names is not None else []
+    return names[:num_classes] + [str(i) for i in range(len(names), num_classes)]
+
+
+def confusion_matrix(
+    y_true: np.ndarray, y_pred: np.ndarray, num_classes: int
+) -> np.ndarray:
+    """(num_classes, num_classes) counts; rows = truth, cols = prediction."""
+    idx = y_true.astype(np.int64) * num_classes + y_pred.astype(np.int64)
+    return np.bincount(idx, minlength=num_classes * num_classes).reshape(
+        num_classes, num_classes
+    )
+
+
+def pr_curve(
+    y_true_bin: np.ndarray, scores: np.ndarray, max_points: int = 64
+) -> List[List[float]]:
+    """One-vs-rest precision/recall pairs, downsampled to ``max_points``.
+
+    Sweeps the decision threshold over the sorted scores (the exact curve,
+    then uniform index downsampling — preserves endpoints, cheap to plot).
+    Returns [[recall, precision], ...] ordered by increasing recall.
+    """
+    order = np.argsort(-scores, kind="stable")
+    tp = np.cumsum(y_true_bin[order])
+    fp = np.cumsum(1 - y_true_bin[order])
+    total_pos = int(y_true_bin.sum())
+    if total_pos == 0:
+        return []
+    precision = tp / np.maximum(tp + fp, 1)
+    recall = tp / total_pos
+    if len(recall) > max_points:
+        keep = np.unique(
+            np.linspace(0, len(recall) - 1, max_points).round().astype(int)
+        )
+        precision, recall = precision[keep], recall[keep]
+    return [[float(r), float(p)] for r, p in zip(recall, precision)]
+
+
+def average_precision(y_true_bin: np.ndarray, scores: np.ndarray) -> float:
+    """AP = sum over positives of precision at each recall step."""
+    order = np.argsort(-scores, kind="stable")
+    hits = y_true_bin[order]
+    total_pos = int(hits.sum())
+    if total_pos == 0:
+        return 0.0
+    tp = np.cumsum(hits)
+    precision = tp / np.arange(1, len(hits) + 1)
+    return float((precision * hits).sum() / total_pos)
+
+
+def classification_report(
+    y_true: np.ndarray,
+    probs: np.ndarray,
+    class_names: Optional[Sequence[str]] = None,
+    top_worst: int = 16,
+) -> Dict[str, Any]:
+    """Full classification report payload (see module docstring).
+
+    ``probs``: (n, num_classes) scores (softmax or logits — only ranking
+    matters for curves; argmax for labels).  ``y_true``: (n,) indices.
+    """
+    probs = np.asarray(probs, dtype=np.float64)
+    y_true = _as_labels(y_true)
+    keep = y_true >= 0  # negative labels = ignore index
+    y_true, probs = y_true[keep], probs[keep]
+    n_scored = probs.shape[-1]
+    # stray labels beyond the scored classes widen the matrix, not crash it
+    num_classes = max(n_scored, int(y_true.max(initial=-1)) + 1)
+    y_pred = probs.argmax(axis=-1)
+    cm = confusion_matrix(y_true, y_pred, num_classes)
+
+    support = cm.sum(axis=1)
+    pred_count = cm.sum(axis=0)
+    tp = np.diag(cm).astype(np.float64)
+    precision = tp / np.maximum(pred_count, 1)
+    recall = tp / np.maximum(support, 1)
+    f1 = 2 * precision * recall / np.maximum(precision + recall, 1e-12)
+
+    names = _names(class_names, num_classes)
+
+    # normalize scores per-row so curve thresholds are comparable (softmax
+    # if the rows don't already sum to 1)
+    rowsum = probs.sum(axis=-1, keepdims=True)
+    if not np.allclose(rowsum, 1.0, atol=1e-3):
+        z = probs - probs.max(axis=-1, keepdims=True)
+        e = np.exp(z)
+        probs = e / e.sum(axis=-1, keepdims=True)
+
+    curves, aps = {}, {}
+    for c in range(n_scored):
+        bin_true = (y_true == c).astype(np.int64)
+        if bin_true.sum() == 0:
+            continue
+        curves[names[c]] = pr_curve(bin_true, probs[:, c])
+        aps[names[c]] = average_precision(bin_true, probs[:, c])
+
+    # gallery backing data: most-confidently-wrong first
+    wrong = np.nonzero(y_pred != y_true)[0]
+    conf_wrong = probs[wrong, y_pred[wrong]] if len(wrong) else np.empty(0)
+    worst_idx = wrong[np.argsort(-conf_wrong)][:top_worst]
+    worst = [
+        {
+            "index": int(i),
+            "true": names[int(y_true[i])],
+            "pred": names[int(y_pred[i])],
+            "confidence": float(probs[i, y_pred[i]]),
+        }
+        for i in worst_idx
+    ]
+
+    return {
+        "kind": "classification",
+        "n": int(len(y_true)),
+        "accuracy": float((y_pred == y_true).mean()) if len(y_true) else 0.0,
+        "class_names": names,
+        "confusion": cm.tolist(),
+        "per_class": [
+            {
+                "name": names[c],
+                "precision": float(precision[c]),
+                "recall": float(recall[c]),
+                "f1": float(f1[c]),
+                "support": int(support[c]),
+            }
+            for c in range(num_classes)
+        ],
+        "pr_curves": curves,
+        "average_precision": aps,
+        "mean_average_precision": (
+            float(np.mean(list(aps.values()))) if aps else 0.0
+        ),
+        "worst": worst,
+    }
+
+
+def segmentation_report(
+    y_true: np.ndarray,
+    y_pred: np.ndarray,
+    num_classes: Optional[int] = None,
+    class_names: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """Pixel-level report: accuracy, per-class IoU + dice, confusion.
+
+    ``y_true``: (..., H, W) int masks.  ``y_pred``: same shape, or
+    (..., H, W, C) probabilities/logits (argmax'd over the last axis).
+    """
+    y_true = np.asarray(y_true).astype(np.int64)
+    y_pred = np.asarray(y_pred)
+    if y_pred.ndim == y_true.ndim + 1:
+        y_pred = y_pred.argmax(axis=-1)
+    y_pred = y_pred.astype(np.int64)
+    observed = int(max(y_true.max(initial=0), y_pred.max(initial=0))) + 1
+    if num_classes is None:
+        num_classes = observed
+    num_classes = max(num_classes, observed)  # stray labels must not crash
+
+    cm = confusion_matrix(y_true.ravel(), y_pred.ravel(), num_classes)
+    tp = np.diag(cm).astype(np.float64)
+    fp = cm.sum(axis=0) - tp
+    fn = cm.sum(axis=1) - tp
+    union = tp + fp + fn
+    iou = tp / np.maximum(union, 1)
+    dice = 2 * tp / np.maximum(2 * tp + fp + fn, 1)
+    present = cm.sum(axis=1) > 0
+
+    names = _names(class_names, num_classes)
+    return {
+        "kind": "segmentation",
+        "n_pixels": int(cm.sum()),
+        "pixel_accuracy": float(tp.sum() / max(cm.sum(), 1)),
+        "mean_iou": float(iou[present].mean()) if present.any() else 0.0,
+        "mean_dice": float(dice[present].mean()) if present.any() else 0.0,
+        "class_names": names,
+        "confusion": cm.tolist(),
+        "per_class": [
+            {
+                "name": names[c],
+                "iou": float(iou[c]),
+                "dice": float(dice[c]),
+                "pixels": int(cm.sum(axis=1)[c]),
+            }
+            for c in range(num_classes)
+        ],
+    }

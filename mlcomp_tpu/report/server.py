@@ -31,6 +31,8 @@ _ROUTES = [
     (re.compile(r"^/api/tasks/(\d+)/logs$"), "task_logs"),
     (re.compile(r"^/api/tasks/(\d+)/metrics$"), "metric_names"),
     (re.compile(r"^/api/tasks/(\d+)/metrics/([\w./-]+)$"), "metric_series"),
+    (re.compile(r"^/api/tasks/(\d+)/reports$"), "task_reports"),
+    (re.compile(r"^/api/reports/(\d+)$"), "report_payload"),
     (re.compile(r"^/api/workers$"), "workers"),
 ]
 
@@ -76,13 +78,14 @@ pre{background:var(--panel);border:1px solid var(--border);color:var(--text2);
 <h2>Workers</h2><table id="workers"></table>
 <h2>Task detail <span id="tasksel"></span></h2>
 <div id="charts" class="charts"></div>
+<div id="reports"></div>
 <pre id="detail">select a task</pre>
 <div id="tip" class="tip"></div>
 <script>
 const J=u=>fetch(u).then(r=>r.json());
 const SVG=(t,a)=>{const e=document.createElementNS('http://www.w3.org/2000/svg',t);
  for(const k in a)e.setAttribute(k,a[k]);return e};
-let curDag=null,curTask=null;
+let curDag=null,curTask=null;const repCache=new Map();
 function row(tr,cells,head){const r=document.createElement('tr');
  for(const c of cells){const d=document.createElement(head?'th':'td');
   if(c instanceof Node)d.appendChild(c);else if(Array.isArray(c)){
@@ -126,8 +129,8 @@ function drawGraph(tasks){
   lb.appendChild(Object.assign(SVG('title',{}),{textContent:t.name+' — '+t.status}));
   g.appendChild(lb);}}
 
-// single-series line chart with crosshair + tooltip; series: [[step,value]..]
-function lineChart(name,series){
+// single-series line chart with crosshair + tooltip; series: [[x,value]..]
+function lineChart(name,series,xlabel='step'){
  const W=300,H=120,PL=44,PR=10,PT=8,PB=18;
  const box=document.createElement('div');box.className='chart';
  const h=document.createElement('h3');h.textContent=name;box.appendChild(h);
@@ -143,7 +146,7 @@ function lineChart(name,series){
   const lb=SVG('text',{x:PL-4,y:yy+3,'text-anchor':'end','font-size':9});
   lb.setAttribute('fill','var(--text2)');lb.textContent=fmt(yv);svg.appendChild(lb);}
  const xl=SVG('text',{x:W-PR,y:H-5,'text-anchor':'end','font-size':9});
- xl.setAttribute('fill','var(--text2)');xl.textContent='step '+x1;svg.appendChild(xl);
+ xl.setAttribute('fill','var(--text2)');xl.textContent=xlabel+' '+fmt(x1);svg.appendChild(xl);
  const path=SVG('path',{fill:'none','stroke-width':2,
   d:series.map((p,i)=>(i?'L':'M')+X(p[0]).toFixed(1)+' '+Y(p[1]).toFixed(1)).join('')});
  path.setAttribute('stroke','var(--series)');svg.appendChild(path);
@@ -166,10 +169,60 @@ function lineChart(name,series){
   dot.setAttribute('visibility','visible');
   tip.style.display='block';tip.style.left=(e.clientX+12)+'px';
   tip.style.top=(e.clientY-10)+'px';
-  tip.textContent=name+' @ step '+p[0]+': '+fmt(p[1])};
+  tip.textContent=name+' @ '+xlabel+' '+fmt(p[0])+': '+fmt(p[1])};
  svg.onmouseleave=()=>{cross.setAttribute('visibility','hidden');
   dot.setAttribute('visibility','hidden');tip.style.display='none'};
  return box}
+
+// confusion matrix heatmap: cell opacity ~ row-normalized count
+function confusionTable(names,cm){
+ const t=document.createElement('table');t.style.width='auto';
+ row(t,['true\\\\pred',...names],true);
+ cm.forEach((r,i)=>{const tr=document.createElement('tr');
+  const th=document.createElement('th');th.textContent=names[i];tr.appendChild(th);
+  const mx=Math.max(...r,1);
+  r.forEach((v,j)=>{const td=document.createElement('td');
+   td.textContent=v;td.style.textAlign='right';
+   td.style.background=v?'color-mix(in srgb,'+
+    (i===j?'var(--ok)':'var(--bad)')+' '+Math.round(12+60*v/mx)+'%,var(--panel))':'';
+   tr.appendChild(td)});
+  t.appendChild(tr)});
+ return t}
+function perClassTable(rows,cols){
+ const t=document.createElement('table');t.style.width='auto';
+ row(t,cols,true);
+ for(const r of rows)row(t,cols.map(c=>typeof r[c]==='number'&&!Number.isInteger(r[c])
+  ?r[c].toFixed(3):r[c]));
+ return t}
+function renderReport(div,rep,p){
+ // unknown kinds and error bodies must not brick the task-detail view
+ if(!p||p.error||(p.kind!=='classification'&&p.kind!=='segmentation'))return;
+ const h=document.createElement('h2');h.textContent='Report: '+rep.name+' ('+p.kind+')';
+ div.appendChild(h);
+ const sum=document.createElement('p');
+ sum.textContent=p.kind==='segmentation'
+  ?'pixel acc '+p.pixel_accuracy.toFixed(4)+' · mIoU '+p.mean_iou.toFixed(4)+
+   ' · mean dice '+p.mean_dice.toFixed(4)+' · '+p.n_pixels+' px'
+  :'accuracy '+p.accuracy.toFixed(4)+' · mAP '+p.mean_average_precision.toFixed(4)+
+   ' · '+p.n+' samples';
+ div.appendChild(sum);
+ if(p.pr_curves&&Object.keys(p.pr_curves).length){
+  const ch=document.createElement('div');ch.className='charts';
+  for(const[name,curve]of Object.entries(p.pr_curves))
+   if(curve.length>1)ch.appendChild(lineChart('PR: '+name+
+    ' (AP '+(p.average_precision[name]||0).toFixed(3)+')',curve,'recall'));
+  div.appendChild(ch)}
+ if(p.per_class){div.appendChild(perClassTable(p.per_class,
+  p.kind==='segmentation'?['name','iou','dice','pixels']
+   :['name','precision','recall','f1','support']))}
+ if(p.confusion&&p.confusion.length<=24){
+  const hh=document.createElement('h3');hh.textContent='Confusion matrix';
+  div.appendChild(hh);div.appendChild(confusionTable(p.class_names,p.confusion))}
+ if(p.worst&&p.worst.length){
+  const hh=document.createElement('h3');
+  hh.textContent='Most-confident mistakes (gallery)';
+  div.appendChild(hh);
+  div.appendChild(perClassTable(p.worst,['index','true','pred','confidence']))}}
 
 async function refresh(){
  const dags=await J('/api/dags');const t=document.getElementById('dags');
@@ -207,6 +260,14 @@ async function showTask(id){
  names.forEach((n,i)=>{const s=series[i];
   if(s.length>1)ch.appendChild(lineChart(n,s));
   if(s.length)out+='metric '+n+' (last): '+s[s.length-1][1]+'\\n'});
+ const reps=await J('/api/tasks/'+id+'/reports');
+ const rdiv=document.getElementById('reports');rdiv.innerHTML='';
+ for(const rep of reps)
+  try{ // payloads are immutable: fetch each report id once per session
+   let p=repCache.get(rep.id);
+   if(!p){p=await J('/api/reports/'+rep.id);repCache.set(rep.id,p)}
+   renderReport(rdiv,rep,p)}
+  catch(e){console.warn('report render failed',rep.id,e)}
  const logs=await J('/api/tasks/'+id+'/logs');
  for(const l of logs)out+='['+l.level+'] '+l.message+'\\n';
  document.getElementById('detail').textContent=out||'(empty)';
@@ -271,6 +332,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _r_metric_series(self, store: Store, task_id: str, name: str):
         return store.metric_series(int(task_id), name)
+
+    def _r_task_reports(self, store: Store, task_id: str):
+        return store.reports(int(task_id))
+
+    def _r_report_payload(self, store: Store, report_id: str):
+        payload = store.report_payload(int(report_id))
+        return payload if payload is not None else {"error": "no such report"}
 
     def _r_workers(self, store: Store):
         return store.workers()

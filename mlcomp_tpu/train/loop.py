@@ -252,16 +252,27 @@ class Trainer:
             self.tracer.save(self.trace_path)
         return last
 
-    def predict(self, split: str = "infer") -> np.ndarray:
+    def predict(self, split: str = "infer", return_labels: bool = False):
         """Forward pass over a split; returns stacked host outputs (padding
-        from non-drop_last tail batches stripped via the 'valid' mask)."""
-        outs = []
+        from non-drop_last tail batches stripped via the 'valid' mask).
+
+        ``return_labels=True`` also returns the labels gathered from the
+        SAME batches — the only alignment that survives a shuffled loader."""
+        outs, labels = [], []
         for batch in self._loader(split):
             out = np.asarray(self._infer_fn(self.state, batch["x"]))
+            y = np.asarray(batch["y"]) if "y" in batch else None
             if "valid" in batch:
-                out = out[np.asarray(batch["valid"]) > 0]
+                keep = np.asarray(batch["valid"]) > 0
+                out = out[keep]
+                y = y[keep] if y is not None else None
             outs.append(out)
-        return np.concatenate(outs, axis=0)
+            if y is not None:
+                labels.append(y)
+        preds = np.concatenate(outs, axis=0)
+        if return_labels:
+            return preds, (np.concatenate(labels, axis=0) if labels else None)
+        return preds
 
     @property
     def steps_per_epoch(self) -> int:

@@ -1,0 +1,236 @@
+"""Report artifacts: classification/segmentation payload numerics, store
+persistence, server endpoints, and the valid-executor wiring."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.report.artifacts import (
+    average_precision,
+    classification_report,
+    confusion_matrix,
+    pr_curve,
+    segmentation_report,
+)
+
+
+def test_confusion_matrix_counts():
+    y_true = np.array([0, 0, 1, 1, 2])
+    y_pred = np.array([0, 1, 1, 1, 0])
+    cm = confusion_matrix(y_true, y_pred, 3)
+    assert cm.tolist() == [[1, 1, 0], [0, 2, 0], [1, 0, 0]]
+
+
+def test_pr_curve_perfect_ranking():
+    # positives scored above all negatives -> precision 1.0 at every recall
+    y = np.array([1, 1, 0, 0])
+    s = np.array([0.9, 0.8, 0.2, 0.1])
+    curve = pr_curve(y, s)
+    assert curve[0] == [0.5, 1.0]
+    assert [1.0, 1.0] in curve
+    assert average_precision(y, s) == pytest.approx(1.0)
+
+
+def test_pr_curve_no_positives_empty():
+    assert pr_curve(np.zeros(4, dtype=int), np.ones(4)) == []
+    assert average_precision(np.zeros(4, dtype=int), np.ones(4)) == 0.0
+
+
+def test_classification_report_payload():
+    # 3 classes, one confident mistake (sample 3: true 2 scored as 0)
+    y_true = np.array([0, 1, 2, 2])
+    probs = np.array(
+        [
+            [0.8, 0.1, 0.1],
+            [0.1, 0.8, 0.1],
+            [0.1, 0.1, 0.8],
+            [0.9, 0.05, 0.05],
+        ]
+    )
+    rep = classification_report(y_true, probs, class_names=["a", "b", "c"])
+    assert rep["kind"] == "classification"
+    assert rep["accuracy"] == pytest.approx(0.75)
+    assert rep["confusion"][2] == [1, 0, 1]
+    by_name = {r["name"]: r for r in rep["per_class"]}
+    assert by_name["c"]["recall"] == pytest.approx(0.5)
+    assert by_name["c"]["precision"] == pytest.approx(1.0)
+    assert by_name["c"]["support"] == 2
+    # gallery: the single mistake, confidently wrong
+    assert rep["worst"] == [
+        {"index": 3, "true": "c", "pred": "a", "confidence": pytest.approx(0.9)}
+    ]
+    assert set(rep["pr_curves"]) == {"a", "b", "c"}
+    assert 0.0 < rep["mean_average_precision"] <= 1.0
+    json.dumps(rep)  # payload must be JSON-able
+
+
+def test_classification_report_accepts_logits():
+    y_true = np.array([0, 1])
+    logits = np.array([[5.0, -5.0], [-5.0, 5.0]])
+    rep = classification_report(y_true, logits)
+    assert rep["accuracy"] == 1.0
+    assert rep["worst"] == []
+
+
+def test_segmentation_report_payload():
+    y_true = np.zeros((2, 4, 4), dtype=np.int64)
+    y_true[:, 2:, :] = 1
+    y_pred = np.zeros((2, 4, 4), dtype=np.int64)
+    y_pred[:, 1:, :] = 1  # over-predicts class 1 by one row
+    rep = segmentation_report(y_true, y_pred, num_classes=2)
+    assert rep["kind"] == "segmentation"
+    assert rep["pixel_accuracy"] == pytest.approx(0.75)
+    by_name = {r["name"]: r for r in rep["per_class"]}
+    # class1: tp=16, fp=8, fn=0 -> iou 16/24
+    assert by_name["1"]["iou"] == pytest.approx(16 / 24)
+    assert by_name["1"]["dice"] == pytest.approx(32 / 40)
+    assert 0 < rep["mean_iou"] < 1
+    json.dumps(rep)
+
+
+def test_segmentation_report_argmaxes_probs():
+    y_true = np.zeros((1, 2, 2), dtype=np.int64)
+    probs = np.zeros((1, 2, 2, 3))
+    probs[..., 0] = 1.0
+    rep = segmentation_report(y_true, probs, num_classes=3)
+    assert rep["pixel_accuracy"] == 1.0
+
+
+def test_store_report_roundtrip(tmp_db):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="noop"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    rid = store.add_report(tid, "valid_cls", {"kind": "classification", "n": 4})
+    reps = store.reports(tid)
+    assert len(reps) == 1 and reps[0]["kind"] == "classification"
+    assert store.report_payload(rid)["n"] == 4
+    assert store.report_payload(9999) is None
+    store.close()
+
+
+def test_server_report_endpoints(tmp_db):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.report.server import start_in_thread
+
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="noop"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    rid = store.add_report(tid, "r", {"kind": "segmentation", "mean_iou": 0.5})
+    srv, port = start_in_thread(tmp_db)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/tasks/{tid}/reports"
+        ) as r:
+            reps = json.loads(r.read())
+        assert reps[0]["id"] == rid and reps[0]["kind"] == "segmentation"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/reports/{rid}"
+        ) as r:
+            assert json.loads(r.read())["mean_iou"] == 0.5
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+            html = r.read().decode()
+        for needle in ("renderReport", "confusionTable", "PR: "):
+            assert needle in html, needle
+    finally:
+        srv.shutdown()
+        store.close()
+
+
+def test_valid_executor_emits_report(tmp_db):
+    """End-to-end: valid task with report: config persists a classification
+    payload into the store."""
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": ["accuracy"],
+        "data": {
+            "valid": {
+                "name": "synthetic_classification",
+                "n": 24,
+                "num_classes": 3,
+                "dim": 8,
+                "batch_size": 8,
+            }
+        },
+        "report": {"kind": "classification", "top_worst": 4},
+    }
+    ctx = ExecutionContext(
+        dag_id=dag_id, task_id=tid, task_name="v", args=cfg, store=store
+    )
+    ok, result, err = run_task("valid", ctx)
+    assert ok, err
+    reps = store.reports(tid)
+    assert len(reps) == 1 and reps[0]["kind"] == "classification"
+    payload = store.report_payload(reps[0]["id"])
+    assert payload["n"] == 24 and len(payload["confusion"]) == 3
+    assert len(payload["worst"]) <= 4
+    store.close()
+
+
+def test_names_padded_when_class_list_short():
+    y_true = np.array([0, 1, 2])
+    probs = np.eye(3)
+    rep = classification_report(y_true, probs, class_names=["a", "b"])
+    assert rep["class_names"] == ["a", "b", "2"]
+    seg = segmentation_report(
+        np.array([[[0, 2]]]), np.array([[[0, 2]]]), class_names=["bg"]
+    )
+    assert seg["class_names"] == ["bg", "1", "2"]
+
+
+def test_predict_labels_align_under_shuffle():
+    """Labels returned by predict come from the same (shuffled) batches."""
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {
+            "valid": {
+                "name": "synthetic_classification",
+                "n": 30,
+                "num_classes": 3,
+                "dim": 8,
+                "batch_size": 8,
+                "shuffle": True,
+            }
+        },
+    }
+    t = Trainer(cfg)
+    preds, labels = t.predict("valid", return_labels=True)
+    assert preds.shape[0] == labels.shape[0] == 30
+    # the dataset's label multiset must survive the shuffle round-trip
+    orig = np.sort(np.asarray(t.loaders["valid"].data["y"]))
+    assert np.array_equal(np.sort(labels), orig)
+
+
+def test_classification_report_stray_and_ignore_labels():
+    """Labels outside [0, n_scored) widen the confusion matrix; negative
+    labels are treated as ignore-index and dropped."""
+    y_true = np.array([0, 1, 3, -1])  # 3 is beyond the 3-wide head; -1 ignored
+    probs = np.eye(3)[[0, 1, 2, 0]]
+    rep = classification_report(y_true, probs)
+    assert rep["n"] == 3
+    assert len(rep["confusion"]) == 4  # widened to cover stray class 3
+    assert rep["confusion"][3][2] == 1  # stray true=3 predicted as 2
+    assert set(rep["pr_curves"]) <= {"0", "1", "2"}  # only scored classes
