@@ -15,6 +15,7 @@ opens a short transaction so crash recovery is just "reopen the file".
 from __future__ import annotations
 
 import json
+import math
 import os
 import sqlite3
 import time
@@ -67,7 +68,7 @@ CREATE TABLE IF NOT EXISTS metrics (
     ts      REAL NOT NULL,
     name    TEXT NOT NULL,
     step    INTEGER NOT NULL DEFAULT 0,
-    value   REAL NOT NULL
+    value   REAL
 );
 CREATE INDEX IF NOT EXISTS idx_metrics_task ON metrics (task_id, name, step);
 CREATE TABLE IF NOT EXISTS reports (
@@ -101,7 +102,74 @@ class Store:
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.executescript(_SCHEMA)
+        self._migrate()
         self._conn.commit()
+
+    def _migrate(self) -> None:
+        """Schema drift fixes for stores created by older builds.
+
+        metrics.value was once NOT NULL; NaN metrics (diverged training)
+        bind as NULL in sqlite, so legacy files must be rebuilt (ALTER
+        can't drop NOT NULL).  The rebuild runs inside one BEGIN IMMEDIATE
+        transaction: concurrent Store() opens serialize on the write lock
+        and re-check the schema after acquiring it, and a crash mid-rebuild
+        rolls back.  A stranded ``metrics_legacy`` (from a pre-atomic build
+        dying mid-copy) is folded back in first."""
+
+        def value_notnull() -> bool:
+            cols = {
+                r["name"]: r
+                for r in self._conn.execute("PRAGMA table_info(metrics)")
+            }
+            return bool(cols) and bool(cols["value"]["notnull"])
+
+        def legacy_present() -> bool:
+            return (
+                self._conn.execute(
+                    "SELECT 1 FROM sqlite_master"
+                    " WHERE type='table' AND name='metrics_legacy'"
+                ).fetchone()
+                is not None
+            )
+
+        if not value_notnull() and not legacy_present():
+            return
+        self._conn.commit()  # close the implicit schema-create transaction
+        self._conn.execute("BEGIN IMMEDIATE")
+        try:
+            if legacy_present():  # recover rows stranded by an old build
+                self._conn.execute(
+                    "INSERT OR IGNORE INTO metrics"
+                    " (id, task_id, ts, name, step, value)"
+                    " SELECT id, task_id, ts, name, step, value"
+                    " FROM metrics_legacy"
+                )
+                self._conn.execute("DROP TABLE metrics_legacy")
+            if value_notnull():
+                self._conn.execute(
+                    "ALTER TABLE metrics RENAME TO metrics_legacy"
+                )
+                self._conn.execute(
+                    "CREATE TABLE metrics ("
+                    " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                    " task_id INTEGER NOT NULL, ts REAL NOT NULL,"
+                    " name TEXT NOT NULL, step INTEGER NOT NULL DEFAULT 0,"
+                    " value REAL)"
+                )
+                self._conn.execute(
+                    "INSERT INTO metrics (id, task_id, ts, name, step, value)"
+                    " SELECT id, task_id, ts, name, step, value"
+                    " FROM metrics_legacy"
+                )
+                self._conn.execute("DROP TABLE metrics_legacy")
+                self._conn.execute(
+                    "CREATE INDEX IF NOT EXISTS idx_metrics_task"
+                    " ON metrics (task_id, name, step)"
+                )
+            self._conn.commit()
+        except Exception:
+            self._conn.rollback()
+            raise
 
     def close(self) -> None:
         self._conn.close()
@@ -334,15 +402,19 @@ class Store:
         return [dict(r) for r in rows]
 
     def metric(self, task_id: int, name: str, value: float, step: int = 0) -> None:
+        # NaN/inf (diverged training) are recorded as NULL — sqlite binds
+        # NaN to NULL anyway; making it explicit keeps the insert valid
+        v = float(value)
         with self._tx() as c:
             c.execute(
                 "INSERT INTO metrics (task_id, ts, name, step, value) VALUES (?,?,?,?,?)",
-                (task_id, time.time(), name, step, float(value)),
+                (task_id, time.time(), name, step, v if math.isfinite(v) else None),
             )
 
     def metric_series(self, task_id: int, name: str) -> List[Tuple[int, float]]:
         rows = self._conn.execute(
-            "SELECT step, value FROM metrics WHERE task_id=? AND name=? ORDER BY step",
+            "SELECT step, value FROM metrics WHERE task_id=? AND name=?"
+            " AND value IS NOT NULL ORDER BY step",
             (task_id, name),
         ).fetchall()
         return [(r["step"], r["value"]) for r in rows]
@@ -358,7 +430,21 @@ class Store:
 
     def add_report(self, task_id: int, name: str, payload: Dict[str, Any]) -> int:
         """Persist a report artifact (classification/segmentation/... payload
-        from report/artifacts.py); ``kind`` is read off the payload."""
+        from report/artifacts.py); ``kind`` is read off the payload.
+
+        Non-finite floats become null: bare ``NaN`` in the stored JSON is
+        rejected by every spec-compliant parser (the dashboard's
+        ``JSON.parse`` included), which would hide the whole report."""
+
+        def clean(o):
+            if isinstance(o, float):
+                return o if math.isfinite(o) else None
+            if isinstance(o, dict):
+                return {k: clean(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return [clean(v) for v in o]
+            return o
+
         with self._tx() as c:
             cur = c.execute(
                 "INSERT INTO reports (task_id, ts, name, kind, payload)"
@@ -368,7 +454,7 @@ class Store:
                     time.time(),
                     name,
                     str(payload.get("kind", "generic")),
-                    json.dumps(payload),
+                    json.dumps(clean(payload), allow_nan=False),
                 ),
             )
             return int(cur.lastrowid)

@@ -38,6 +38,16 @@ def _find_ckpt_dir(ctx: ExecutionContext, args: Dict[str, Any]) -> Optional[str]
     return None
 
 
+def _widened_sum(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sum two confusion matrices, zero-padding the smaller one — batches
+    of pre-argmaxed masks may each observe a different number of classes."""
+    n = max(a.shape[0], b.shape[0])
+    out = np.zeros((n, n), dtype=a.dtype)
+    out[: a.shape[0], : a.shape[1]] += a
+    out[: b.shape[0], : b.shape[1]] += b
+    return out
+
+
 class InferExecutor(Executor):
     name = "infer"
 
@@ -55,9 +65,14 @@ class InferExecutor(Executor):
         else:
             ctx.log("no checkpoint found; inferring with fresh params", level="warning")
         split = "infer" if "infer" in trainer.loaders else "valid"
-        preds = trainer.predict(split)
+        # labels (when the split has them) ride along batch-aligned, so
+        # downstream scoring tasks never re-pair by dataset order
+        preds, labels = trainer.predict(split, return_labels=True)
         out_path.parent.mkdir(parents=True, exist_ok=True)
-        np.savez_compressed(out_path, preds=preds)
+        if labels is not None:
+            np.savez_compressed(out_path, preds=preds, labels=labels)
+        else:
+            np.savez_compressed(out_path, preds=preds)
         ctx.log(f"wrote {preds.shape} predictions -> {out_path}")
         return {"preds": str(out_path), "n": int(preds.shape[0])}
 
@@ -81,7 +96,7 @@ class ValidExecutor(Executor):
                 "no checkpoint found; validating fresh params", level="warning"
             )
         stats = None
-        if report_cfg:
+        if report_cfg is not None and report_cfg is not False:
             # reports are auxiliary: never fail a valid task over a
             # malformed report option — fall back to the plain eval pass
             try:
@@ -99,33 +114,153 @@ class ValidExecutor(Executor):
     def _valid_with_report(
         ctx: ExecutionContext, trainer, report_cfg: Any
     ) -> Dict[str, float]:
-        """One forward pass serves both the report payload and the scalar
-        metrics (losses/metrics are pure ``(outputs, batch)`` fns, so they
-        evaluate on the collected outputs — no second device pass)."""
+        """One streamed forward pass serves both the report payload and the
+        scalar metrics.
+
+        Per batch: loss/metrics evaluate on the device outputs with the
+        SAME masked-mean-then-average-over-batches formula ``eval_epoch``
+        uses, so enabling ``report:`` never changes the logged metric
+        values.  Report state stays bounded: segmentation accumulates a
+        pixel confusion matrix per batch (masks are never all held);
+        classification keeps at most ``max_samples`` score rows for the
+        PR curves/gallery.  The payload is persisted only after the stats
+        succeed — a failure can't leave an orphaned report behind.
+        """
         from mlcomp_tpu.report.artifacts import (
             classification_report,
-            segmentation_report,
+            confusion_matrix,
+            segmentation_report_from_confusion,
         )
 
-        rc = report_cfg if isinstance(report_cfg, dict) else {}
-        # labels come from the same batches as the predictions, so the
-        # pairing holds even if the valid split is configured shuffled
-        preds, y_true = trainer.predict("valid", return_labels=True)
-        if y_true is None:
-            raise ValueError("valid split has no labels")
-        kind = rc.get("kind") or ("segmentation" if preds.ndim >= 3 else "classification")
+        import jax
+
+        # YAML shorthands: `report: segmentation` == `report: {kind: ...}`;
+        # `report: true` == all defaults
+        if isinstance(report_cfg, str):
+            rc: Dict[str, Any] = {"kind": report_cfg}
+        elif isinstance(report_cfg, dict):
+            rc = report_cfg
+        else:
+            rc = {}
+        max_samples = int(rc.get("max_samples", 16384))
+        ignore_label = rc.get("ignore_label")
+        kind = rc.get("kind")
+        if kind not in (None, "classification", "segmentation"):
+            raise ValueError(f"unknown report kind {kind!r}")
         names = rc.get("classes")
+
+        # ONE jitted dispatch per batch: outputs + the very same eval step
+        # eval_epoch runs (shared code so the formulas can never diverge);
+        # XLA CSEs the duplicated forward inside the single jit
+        from mlcomp_tpu.train.loop import make_eval_step
+
+        eval_step = make_eval_step(trainer.loss_fn, trainer.metric_fns)
+
+        def fwd_stats(state, batch):
+            out = state.apply_fn(state.variables, batch["x"], train=False)
+            return out, eval_step(state, batch)
+
+        fwd = jax.jit(fwd_stats)
+
+        agg: Dict[str, Any] = {}
+        n_batches = 0
+        cm = None
+        kept_p, kept_y, kept_i = [], [], []
+        stream_pos = 0  # position in the unfiltered valid stream
+        kept_n = 0      # filtered rows actually kept (fills max_samples)
+        truncated = False
+
+        for batch in trainer._loader("valid"):
+            out_dev, per = fwd(trainer.state, batch)
+            for k, v in per.items():
+                agg[k] = agg.get(k, 0.0) + v  # device-side accumulation
+            n_batches += 1
+
+            if "y" not in batch:
+                raise ValueError("valid split has no labels")
+            out = np.asarray(out_dev)
+            y = np.asarray(batch["y"])
+            if "valid" in batch:
+                keep = np.asarray(batch["valid"]) > 0
+                out, y = out[keep], y[keep]
+            if kind is None:
+                # spatial labels -> segmentation; per-sample labels with 2D
+                # logits -> classification; anything else (e.g. LM logits
+                # (B,S,V) with scalar labels) has no sensible auto-report
+                if out.ndim == y.ndim + 1 and y.ndim >= 2:
+                    kind = "segmentation"
+                elif out.ndim == 2 and (y.ndim == 1 or y.shape == out.shape):
+                    kind = "classification"  # index or one-hot labels
+                else:
+                    raise ValueError(
+                        f"cannot infer report kind for outputs {out.shape} "
+                        f"vs labels {y.shape}; set report.kind explicitly"
+                    )
+            if kind == "segmentation":
+                yp = out.argmax(axis=-1) if out.ndim == y.ndim + 1 else out
+                yt, yp = y.astype(np.int64).ravel(), yp.astype(np.int64).ravel()
+                m = yt >= 0
+                if ignore_label is not None:
+                    m &= yt != ignore_label
+                yt, yp = yt[m], yp[m]
+                # logits fix the class count; pre-argmaxed maps grow it with
+                # whatever classes appear AFTER ignore filtering (a 255 void
+                # label must not widen the matrix to 256)
+                n_cls = out.shape[-1] if out.ndim == y.ndim + 1 else int(
+                    max(yt.max(initial=0), yp.max(initial=0))
+                ) + 1
+                keep2 = (yt < n_cls) & (yp < n_cls)
+                delta = confusion_matrix(yt[keep2], yp[keep2], n_cls)
+                cm = delta if cm is None else _widened_sum(cm, delta)
+            else:
+                if y.ndim > 1:  # one-hot / soft labels -> class indices
+                    y = y.argmax(axis=-1)
+                # stream positions BEFORE filtering: gallery indices stay
+                # aligned with the (unshuffled) valid stream
+                pos = stream_pos + np.arange(len(y))
+                stream_pos += len(y)
+                m = y >= 0
+                if ignore_label is not None:
+                    m &= y != ignore_label
+                out2, y2, pos2 = out[m], y[m], pos[m]
+                room = max_samples - kept_n
+                if len(y2) > room:
+                    truncated = True
+                if room > 0 and len(y2) > 0:
+                    kept_p.append(out2[:room].astype(np.float32))
+                    kept_y.append(y2[:room])
+                    kept_i.append(pos2[:room])
+                    kept_n += min(room, len(y2))
+
+        stats = {k: float(v) / max(n_batches, 1) for k, v in agg.items()}
+
+        if (kind == "segmentation" and (cm is None or cm.sum() == 0)) or (
+            kind != "segmentation" and kept_n == 0
+        ):
+            # stats are still good — just nothing eligible to report on
+            ctx.log("no eligible samples for report", level="warning")
+            return stats
+
         if kind == "segmentation":
-            payload = segmentation_report(y_true, preds, class_names=names)
+            payload = segmentation_report_from_confusion(cm, class_names=names)
         else:
             payload = classification_report(
-                y_true, preds, class_names=names,
+                np.concatenate(kept_y),
+                np.concatenate(kept_p),
+                class_names=names,
                 top_worst=int(rc.get("top_worst", 16)),
+                sample_indices=np.concatenate(kept_i),
             )
+            if truncated:
+                payload["truncated_to"] = kept_n
+                ctx.log(
+                    f"report kept the first {kept_n} eligible examples "
+                    f"(of a {stream_pos}-sample stream)",
+                    level="warning",
+                )
         ctx.report(rc.get("name", f"{ctx.task_name}_{kind}"), payload)
-        ctx.log(f"report: {kind} over {payload.get('n', payload.get('n_pixels'))} samples")
-        batch = {"y": y_true}
-        stats = {"loss": float(trainer.loss_fn(preds, batch))}
-        for name, fn in trainer.metric_fns.items():
-            stats[name] = float(fn(preds, batch))
+        ctx.log(
+            f"report: {kind} over "
+            f"{payload.get('n', payload.get('n_pixels'))} samples"
+        )
         return stats

@@ -47,41 +47,40 @@ def confusion_matrix(
     )
 
 
-def pr_curve(
+def _pr_curve_and_ap(
     y_true_bin: np.ndarray, scores: np.ndarray, max_points: int = 64
-) -> List[List[float]]:
-    """One-vs-rest precision/recall pairs, downsampled to ``max_points``.
-
-    Sweeps the decision threshold over the sorted scores (the exact curve,
-    then uniform index downsampling — preserves endpoints, cheap to plot).
-    Returns [[recall, precision], ...] ordered by increasing recall.
-    """
-    order = np.argsort(-scores, kind="stable")
-    tp = np.cumsum(y_true_bin[order])
-    fp = np.cumsum(1 - y_true_bin[order])
+) -> Tuple[List[List[float]], float]:
+    """One sort serves both the PR curve and its AP — this pair is the hot
+    spot of a many-class report, so the O(n log n) work is shared."""
     total_pos = int(y_true_bin.sum())
     if total_pos == 0:
-        return []
-    precision = tp / np.maximum(tp + fp, 1)
+        return [], 0.0
+    order = np.argsort(-scores, kind="stable")
+    hits = y_true_bin[order]
+    tp = np.cumsum(hits)
+    precision = tp / np.arange(1, len(hits) + 1)
     recall = tp / total_pos
+    ap = float((precision * hits).sum() / total_pos)
     if len(recall) > max_points:
         keep = np.unique(
             np.linspace(0, len(recall) - 1, max_points).round().astype(int)
         )
         precision, recall = precision[keep], recall[keep]
-    return [[float(r), float(p)] for r, p in zip(recall, precision)]
+    curve = [[float(r), float(p)] for r, p in zip(recall, precision)]
+    return curve, ap
+
+
+def pr_curve(
+    y_true_bin: np.ndarray, scores: np.ndarray, max_points: int = 64
+) -> List[List[float]]:
+    """One-vs-rest precision/recall pairs ([[recall, precision], ...],
+    increasing recall), downsampled to ``max_points`` preserving endpoints."""
+    return _pr_curve_and_ap(y_true_bin, scores, max_points)[0]
 
 
 def average_precision(y_true_bin: np.ndarray, scores: np.ndarray) -> float:
     """AP = sum over positives of precision at each recall step."""
-    order = np.argsort(-scores, kind="stable")
-    hits = y_true_bin[order]
-    total_pos = int(hits.sum())
-    if total_pos == 0:
-        return 0.0
-    tp = np.cumsum(hits)
-    precision = tp / np.arange(1, len(hits) + 1)
-    return float((precision * hits).sum() / total_pos)
+    return _pr_curve_and_ap(y_true_bin, scores)[1]
 
 
 def classification_report(
@@ -89,16 +88,28 @@ def classification_report(
     probs: np.ndarray,
     class_names: Optional[Sequence[str]] = None,
     top_worst: int = 16,
+    sample_indices: Optional[np.ndarray] = None,
+    max_confusion: int = 64,
 ) -> Dict[str, Any]:
     """Full classification report payload (see module docstring).
 
     ``probs``: (n, num_classes) scores (softmax or logits — only ranking
-    matters for curves; argmax for labels).  ``y_true``: (n,) indices.
+    matters for curves; argmax for labels).  ``y_true``: (n,) indices or
+    one-hot rows.  ``sample_indices``: per-row identifiers reported in the
+    gallery (defaults to row position); the gallery stays correct when the
+    caller pre-filtered rows.  Confusion matrices wider than
+    ``max_confusion`` are omitted from the payload (the dashboard won't
+    render them and at e.g. 1000 classes the nested list dominates the db).
     """
     probs = np.asarray(probs, dtype=np.float64)
     y_true = _as_labels(y_true)
+    idx = (
+        np.asarray(sample_indices)
+        if sample_indices is not None
+        else np.arange(len(y_true))
+    )
     keep = y_true >= 0  # negative labels = ignore index
-    y_true, probs = y_true[keep], probs[keep]
+    y_true, probs, idx = y_true[keep], probs[keep], idx[keep]
     n_scored = probs.shape[-1]
     # stray labels beyond the scored classes widen the matrix, not crash it
     num_classes = max(n_scored, int(y_true.max(initial=-1)) + 1)
@@ -122,13 +133,23 @@ def classification_report(
         e = np.exp(z)
         probs = e / e.sum(axis=-1, keepdims=True)
 
+    # AP for every scored class; stored curves capped to the highest-support
+    # classes (a 1000-class payload would otherwise dwarf everything else)
+    max_curves = 32
+    by_support = set(
+        sorted(range(n_scored), key=lambda c: int(support[c]), reverse=True)[
+            :max_curves
+        ]
+    )
     curves, aps = {}, {}
     for c in range(n_scored):
         bin_true = (y_true == c).astype(np.int64)
         if bin_true.sum() == 0:
             continue
-        curves[names[c]] = pr_curve(bin_true, probs[:, c])
-        aps[names[c]] = average_precision(bin_true, probs[:, c])
+        curve, ap = _pr_curve_and_ap(bin_true, probs[:, c])
+        if c in by_support:
+            curves[names[c]] = curve
+        aps[names[c]] = ap
 
     # gallery backing data: most-confidently-wrong first
     wrong = np.nonzero(y_pred != y_true)[0]
@@ -136,7 +157,7 @@ def classification_report(
     worst_idx = wrong[np.argsort(-conf_wrong)][:top_worst]
     worst = [
         {
-            "index": int(i),
+            "index": int(idx[i]),
             "true": names[int(y_true[i])],
             "pred": names[int(y_pred[i])],
             "confidence": float(probs[i, y_pred[i]]),
@@ -149,7 +170,7 @@ def classification_report(
         "n": int(len(y_true)),
         "accuracy": float((y_pred == y_true).mean()) if len(y_true) else 0.0,
         "class_names": names,
-        "confusion": cm.tolist(),
+        "confusion": cm.tolist() if num_classes <= max_confusion else None,
         "per_class": [
             {
                 "name": names[c],
@@ -174,30 +195,51 @@ def segmentation_report(
     y_pred: np.ndarray,
     num_classes: Optional[int] = None,
     class_names: Optional[Sequence[str]] = None,
+    ignore_label: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Pixel-level report: accuracy, per-class IoU + dice, confusion.
 
     ``y_true``: (..., H, W) int masks.  ``y_pred``: same shape, or
     (..., H, W, C) probabilities/logits (argmax'd over the last axis).
+    Negative labels and ``ignore_label`` (the 255 convention) are excluded.
     """
     y_true = np.asarray(y_true).astype(np.int64)
     y_pred = np.asarray(y_pred)
     if y_pred.ndim == y_true.ndim + 1:
         y_pred = y_pred.argmax(axis=-1)
-    y_pred = y_pred.astype(np.int64)
+    y_true = y_true.ravel()
+    y_pred = y_pred.astype(np.int64).ravel()
+    keep = y_true >= 0
+    if ignore_label is not None:
+        keep &= y_true != ignore_label
+    y_true, y_pred = y_true[keep], y_pred[keep]
     observed = int(max(y_true.max(initial=0), y_pred.max(initial=0))) + 1
     if num_classes is None:
         num_classes = observed
     num_classes = max(num_classes, observed)  # stray labels must not crash
 
-    cm = confusion_matrix(y_true.ravel(), y_pred.ravel(), num_classes)
+    cm = confusion_matrix(y_true, y_pred, num_classes)
+    return segmentation_report_from_confusion(cm, class_names)
+
+
+def segmentation_report_from_confusion(
+    cm: np.ndarray,
+    class_names: Optional[Sequence[str]] = None,
+    max_confusion: int = 64,
+) -> Dict[str, Any]:
+    """Compose the segmentation payload from an (already accumulated)
+    pixel confusion matrix — the streaming path: executors add up
+    per-batch matrices and never hold the full mask set in memory."""
+    cm = np.asarray(cm)
+    num_classes = cm.shape[0]
     tp = np.diag(cm).astype(np.float64)
     fp = cm.sum(axis=0) - tp
     fn = cm.sum(axis=1) - tp
     union = tp + fp + fn
     iou = tp / np.maximum(union, 1)
     dice = 2 * tp / np.maximum(2 * tp + fp + fn, 1)
-    present = cm.sum(axis=1) > 0
+    pixels = tp + fn  # row sums
+    present = pixels > 0
 
     names = _names(class_names, num_classes)
     return {
@@ -207,13 +249,13 @@ def segmentation_report(
         "mean_iou": float(iou[present].mean()) if present.any() else 0.0,
         "mean_dice": float(dice[present].mean()) if present.any() else 0.0,
         "class_names": names,
-        "confusion": cm.tolist(),
+        "confusion": cm.tolist() if num_classes <= max_confusion else None,
         "per_class": [
             {
                 "name": names[c],
                 "iou": float(iou[c]),
                 "dice": float(dice[c]),
-                "pixels": int(cm.sum(axis=1)[c]),
+                "pixels": int(pixels[c]),
             }
             for c in range(num_classes)
         ],

@@ -215,7 +215,7 @@ function renderReport(div,rep,p){
  if(p.per_class){div.appendChild(perClassTable(p.per_class,
   p.kind==='segmentation'?['name','iou','dice','pixels']
    :['name','precision','recall','f1','support']))}
- if(p.confusion&&p.confusion.length<=24){
+ if(p.confusion&&p.confusion.length<=64){ // matches artifacts max_confusion
   const hh=document.createElement('h3');hh.textContent='Confusion matrix';
   div.appendChild(hh);div.appendChild(confusionTable(p.class_names,p.confusion))}
  if(p.worst&&p.worst.length){
@@ -265,7 +265,8 @@ async function showTask(id){
  for(const rep of reps)
   try{ // payloads are immutable: fetch each report id once per session
    let p=repCache.get(rep.id);
-   if(!p){p=await J('/api/reports/'+rep.id);repCache.set(rep.id,p)}
+   if(!p){p=await J('/api/reports/'+rep.id);
+    if(!p.error)repCache.set(rep.id,p)} // don't pin transient errors
    renderReport(rdiv,rep,p)}
   catch(e){console.warn('report render failed',rep.id,e)}
  const logs=await J('/api/tasks/'+id+'/logs');

@@ -31,13 +31,37 @@ def masked_mean(per_example, batch):
     return (per_example * m).sum() / jnp.maximum(m.sum(), 1.0)
 
 
+def _ignore_invalid_labels(per, labels, n_classes, batch):
+    """torch-style ignore_index semantics: integer labels outside
+    [0, n_classes) contribute zero loss and drop out of the denominator.
+
+    Spatial losses collapse to a per-example mean over VALID positions
+    here (masked_mean's plain spatial mean would dilute examples that
+    carry ignore pixels); the example-validity mask then folds into the
+    loader's pad mask for the batch mean."""
+    valid = (labels >= 0) & (labels < n_classes)
+    per = jnp.where(valid, per, 0.0)
+    v = valid.astype(per.dtype)
+    if per.ndim > 1:
+        axes = tuple(range(1, per.ndim))
+        per = per.sum(axes) / jnp.maximum(v.sum(axes), 1.0)
+        v = (v.sum(axes) > 0).astype(per.dtype)
+    m = batch.get("valid") if isinstance(batch, dict) else None
+    b2 = dict(batch) if isinstance(batch, dict) else {}
+    b2["valid"] = v if m is None else v * m
+    return per, b2
+
+
 @LOSSES.register("cross_entropy")
 def cross_entropy(logits, batch):
     labels = batch["y"]
     if labels.ndim == logits.ndim:  # one-hot / soft labels
         per = optax.softmax_cross_entropy(logits, labels)
-    else:
-        per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        return masked_mean(per, batch)
+    n = logits.shape[-1]
+    safe = jnp.clip(labels, 0, n - 1)
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    per, batch = _ignore_invalid_labels(per, labels, n, batch)
     return masked_mean(per, batch)
 
 
@@ -48,7 +72,9 @@ def smoothed_cross_entropy(logits, batch, smoothing: float = 0.1):
     onehot = jnp.where(
         jnp.arange(n)[None, :] == labels[..., None], 1.0 - smoothing, smoothing / (n - 1)
     )
-    return masked_mean(optax.softmax_cross_entropy(logits, onehot), batch)
+    per = optax.softmax_cross_entropy(logits, onehot)
+    per, batch = _ignore_invalid_labels(per, labels, n, batch)
+    return masked_mean(per, batch)
 
 
 @LOSSES.register("bce_with_logits")
@@ -63,8 +89,14 @@ def mse(preds, batch):
 
 @LOSSES.register("pixel_cross_entropy")
 def pixel_cross_entropy(logits, batch):
-    """Per-pixel CE for segmentation: logits (B,H,W,C), labels (B,H,W)."""
-    per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+    """Per-pixel CE for segmentation: logits (B,H,W,C), labels (B,H,W).
+    Out-of-range labels (e.g. the 255 void convention, or -1) are ignored —
+    same semantics as torch's ignore_index."""
+    labels = batch["y"]
+    n = logits.shape[-1]
+    safe = jnp.clip(labels, 0, n - 1)
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    per, batch = _ignore_invalid_labels(per, labels, n, batch)
     return masked_mean(per, batch)
 
 
@@ -80,7 +112,10 @@ def lm_cross_entropy(logits, batch):
 
 @LOSSES.register("dice")
 def dice_loss(logits, batch, eps: float = 1e-6):
-    """Soft dice over one-hot classes; segmentation complement to pixel CE."""
+    """Soft dice over one-hot classes; segmentation complement to pixel CE.
+    Void pixels (labels outside [0, C), e.g. 255 / -1) are excluded from
+    both the prediction and target masses — same ignore_index rule as the
+    CE losses and the metrics."""
     import jax
 
     labels = batch["y"]
@@ -89,6 +124,9 @@ def dice_loss(logits, batch, eps: float = 1e-6):
     onehot = (jnp.arange(n)[None, None, None, :] == labels[..., None]).astype(
         probs.dtype
     )
+    valid = ((labels >= 0) & (labels < n)).astype(probs.dtype)[..., None]
+    probs = probs * valid
+    onehot = onehot * valid
     inter = jnp.sum(probs * onehot, axis=(1, 2))
     union = jnp.sum(probs + onehot, axis=(1, 2))
     return 1.0 - jnp.mean((2 * inter + eps) / (union + eps))

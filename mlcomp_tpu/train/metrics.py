@@ -11,35 +11,46 @@ import jax.numpy as jnp
 
 from mlcomp_tpu.utils.registry import Registry
 
-from mlcomp_tpu.train.losses import masked_mean
+from mlcomp_tpu.train.losses import _ignore_invalid_labels, masked_mean
 
 METRICS: Registry = Registry("metrics")
+
+# Out-of-range labels (negative ignore index, 255 void convention) drop out
+# of every metric below via losses._ignore_invalid_labels — the SAME rule
+# the losses apply, so a logged valid/accuracy can never disagree with the
+# report path's confusion-matrix accuracy over which pixels count.
 
 
 @METRICS.register("accuracy")
 def accuracy(outputs, batch):
-    per = (jnp.argmax(outputs, axis=-1) == batch["y"]).astype(jnp.float32)
+    labels = batch["y"]
+    per = (jnp.argmax(outputs, axis=-1) == labels).astype(jnp.float32)
+    per, batch = _ignore_invalid_labels(per, labels, outputs.shape[-1], batch)
     return masked_mean(per, batch)
 
 
 @METRICS.register("top5_accuracy")
 def top5_accuracy(outputs, batch):
+    labels = batch["y"]
     k = min(5, outputs.shape[-1])
     topk = jnp.argsort(outputs, axis=-1)[..., -k:]
-    hit = jnp.any(topk == batch["y"][..., None], axis=-1)
-    return masked_mean(hit.astype(jnp.float32), batch)
+    hit = jnp.any(topk == labels[..., None], axis=-1).astype(jnp.float32)
+    hit, batch = _ignore_invalid_labels(hit, labels, outputs.shape[-1], batch)
+    return masked_mean(hit, batch)
 
 
 @METRICS.register("miou")
 def miou(outputs, batch, eps: float = 1e-6):
-    """Mean IoU over classes; outputs (B,H,W,C), labels (B,H,W)."""
+    """Mean IoU over classes; outputs (B,H,W,C), labels (B,H,W); pixels
+    with out-of-range labels are excluded from both sides."""
     n = outputs.shape[-1]
     pred = jnp.argmax(outputs, axis=-1)
     labels = batch["y"]
+    valid = (labels >= 0) & (labels < n)
     ious = []
     for c in range(n):  # n is static — unrolls into vector ops
-        p = pred == c
-        l = labels == c
+        p = (pred == c) & valid
+        l = (labels == c) & valid
         inter = jnp.sum(jnp.logical_and(p, l).astype(jnp.float32))
         union = jnp.sum(jnp.logical_or(p, l).astype(jnp.float32))
         ious.append((inter + eps) / (union + eps))
@@ -48,7 +59,9 @@ def miou(outputs, batch, eps: float = 1e-6):
 
 @METRICS.register("pixel_accuracy")
 def pixel_accuracy(outputs, batch):
-    per = (jnp.argmax(outputs, axis=-1) == batch["y"]).astype(jnp.float32)
+    labels = batch["y"]
+    per = (jnp.argmax(outputs, axis=-1) == labels).astype(jnp.float32)
+    per, batch = _ignore_invalid_labels(per, labels, outputs.shape[-1], batch)
     return masked_mean(per, batch)
 
 

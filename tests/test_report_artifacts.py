@@ -234,3 +234,388 @@ def test_classification_report_stray_and_ignore_labels():
     assert len(rep["confusion"]) == 4  # widened to cover stray class 3
     assert rep["confusion"][3][2] == 1  # stray true=3 predicted as 2
     assert set(rep["pr_curves"]) <= {"0", "1", "2"}  # only scored classes
+
+
+def test_segmentation_ignore_labels():
+    """Negative and explicit ignore labels are excluded from pixel stats."""
+    y_true = np.array([[[0, 1], [-1, 255]]])
+    y_pred = np.array([[[0, 1], [0, 1]]])
+    rep = segmentation_report(y_true, y_pred, num_classes=2, ignore_label=255)
+    assert rep["n_pixels"] == 2  # -1 and 255 dropped
+    assert rep["pixel_accuracy"] == 1.0
+    assert len(rep["confusion"]) == 2
+
+
+def test_segmentation_report_from_confusion_matches():
+    from mlcomp_tpu.report.artifacts import segmentation_report_from_confusion
+
+    y_true = np.random.RandomState(0).randint(0, 3, (2, 8, 8))
+    y_pred = np.random.RandomState(1).randint(0, 3, (2, 8, 8))
+    direct = segmentation_report(y_true, y_pred, num_classes=3)
+    cm = confusion_matrix(y_true.ravel(), y_pred.ravel(), 3)
+    streamed = segmentation_report_from_confusion(cm)
+    assert direct["mean_iou"] == streamed["mean_iou"]
+    assert direct["confusion"] == streamed["confusion"]
+
+
+def test_report_path_metrics_match_eval_epoch(tmp_db):
+    """Enabling report: must not change the logged metric values, including
+    with a ragged (padded) tail batch."""
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    data = {
+        "name": "synthetic_classification",
+        "n": 30,  # batch 8 -> ragged tail of 6
+        "num_classes": 3,
+        "dim": 8,
+        "batch_size": 8,
+        "drop_last": False,
+    }
+    base = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": ["accuracy"],
+        "seed": 7,
+        "data": {"valid": data},
+    }
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(
+            name="d",
+            project="p",
+            tasks=(
+                TaskSpec(name="plain", executor="valid"),
+                TaskSpec(name="rep", executor="valid"),
+            ),
+        )
+    )
+    rows = {r["name"]: r["id"] for r in store.task_rows(dag_id)}
+    ok1, res_plain, err1 = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=rows["plain"], task_name="plain",
+                         args=dict(base), store=store),
+    )
+    with_rep = dict(base)
+    with_rep["report"] = {"kind": "classification"}
+    ok2, res_rep, err2 = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=rows["rep"], task_name="rep",
+                         args=with_rep, store=store),
+    )
+    assert ok1 and ok2, (err1, err2)
+    assert res_plain["loss"] == pytest.approx(res_rep["loss"], rel=1e-5)
+    assert res_plain["accuracy"] == pytest.approx(res_rep["accuracy"], rel=1e-5)
+    assert len(store.reports(rows["rep"])) == 1
+    store.close()
+
+
+def test_report_truncates_at_max_samples(tmp_db):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {
+            "valid": {
+                "name": "synthetic_classification",
+                "n": 24,
+                "num_classes": 3,
+                "dim": 8,
+                "batch_size": 8,
+            }
+        },
+        "report": {"kind": "classification", "max_samples": 10},
+    }
+    ok, _, err = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="v",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    payload = store.report_payload(store.reports(tid)[0]["id"])
+    assert payload["n"] == 10 and payload["truncated_to"] == 10
+    store.close()
+
+
+def test_unknown_report_kind_falls_back_with_error_log(tmp_db):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {
+            "valid": {
+                "name": "synthetic_classification",
+                "n": 16, "num_classes": 3, "dim": 8, "batch_size": 8,
+            }
+        },
+        "report": {"kind": "cls"},  # typo'd kind
+    }
+    ok, res, err = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="v",
+                         args=cfg, store=store),
+    )
+    assert ok and "loss" in res
+    assert store.reports(tid) == []
+    msgs = [l["message"] for l in store.task_logs(tid)]
+    assert any("unknown report kind" in m for m in msgs), msgs
+    store.close()
+
+
+def test_widened_sum_pads_confusion():
+    from mlcomp_tpu.executors.infer import _widened_sum
+
+    a = np.array([[1, 0], [0, 1]])
+    b = np.array([[1, 0, 0], [0, 0, 0], [0, 0, 2]])
+    s = _widened_sum(a, b)
+    assert s.tolist() == [[2, 0, 0], [0, 1, 0], [0, 0, 2]]
+    assert _widened_sum(b, a).tolist() == s.tolist()
+
+
+def test_empty_report_dict_enables_defaults(tmp_db):
+    """report: {} means 'report with defaults', not 'disabled'."""
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {
+            "valid": {
+                "name": "synthetic_classification",
+                "n": 16, "num_classes": 3, "dim": 8, "batch_size": 8,
+            }
+        },
+        "report": {},
+    }
+    ok, _, err = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="v",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    assert len(store.reports(tid)) == 1
+    store.close()
+
+
+def test_gallery_indices_survive_filtering():
+    """Gallery indices refer to caller-supplied positions, unshifted by
+    ignore filtering."""
+    y_true = np.array([0, 1, 0])
+    probs = np.array([[0.9, 0.1], [0.95, 0.05], [0.2, 0.8]])  # 1,2 wrong
+    rep = classification_report(
+        y_true, probs, sample_indices=np.array([10, 20, 30])
+    )
+    assert sorted(w["index"] for w in rep["worst"]) == [20, 30]
+
+
+def test_large_class_count_omits_confusion_and_caps_curves():
+    rng = np.random.default_rng(0)
+    n_cls = 100
+    y = rng.integers(0, n_cls, 512)
+    probs = rng.random((512, n_cls))
+    rep = classification_report(y, probs)
+    assert rep["confusion"] is None
+    assert len(rep["pr_curves"]) <= 32
+    assert len(rep["average_precision"]) > 32  # AP still for all classes
+    assert len(rep["per_class"]) == n_cls
+
+
+def test_report_string_shorthand_and_onehot_labels(tmp_db, tmp_path):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {
+            "valid": {
+                "name": "synthetic_classification",
+                "n": 16, "num_classes": 3, "dim": 8, "batch_size": 8,
+                "one_hot": True,
+            }
+        },
+        "report": "classification",  # string shorthand
+    }
+    from mlcomp_tpu.data.datasets import create_dataset
+
+    # one-hot labels: rebuild the dataset arrays by hand if the generator
+    # doesn't support one_hot natively
+    ds = create_dataset(cfg["data"]["valid"])
+    if ds["y"].ndim == 1:
+        onehot = np.eye(3, dtype=np.float32)[ds["y"]]
+        npz_path = str(tmp_path / "onehot_valid.npz")
+        np.savez(npz_path, x=ds["x"], y=onehot)
+        cfg["data"]["valid"] = {"name": "npz", "path": npz_path, "batch_size": 8}
+    ok, res, err = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="v",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    reps = store.reports(tid)
+    assert len(reps) == 1 and reps[0]["kind"] == "classification", (
+        [l["message"] for l in store.task_logs(tid)]
+    )
+    payload = store.report_payload(reps[0]["id"])
+    assert payload["n"] == 16
+    store.close()
+
+
+def test_truncation_budget_counts_filtered_rows(tmp_db, tmp_path):
+    """max_samples fills with ELIGIBLE rows; ignore-filtered rows don't
+    consume budget, and truncated_to reports what was actually kept."""
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    rng = np.random.default_rng(0)
+    x = rng.random((24, 8), dtype=np.float32)
+    y = rng.integers(0, 3, 24)
+    y[::2] = 9  # half the rows carry the ignore label
+    npz_path = str(tmp_path / "v.npz")
+    np.savez(npz_path, x=x, y=y)
+
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {"valid": {"name": "npz", "path": npz_path, "batch_size": 8}},
+        "report": {"kind": "classification", "max_samples": 10,
+                   "ignore_label": 9},
+    }
+    ok, _, err = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="v",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    payload = store.report_payload(store.reports(tid)[0]["id"])
+    # 12 eligible rows, budget 10 -> exactly 10 kept, flagged truncated
+    assert payload["n"] == 10 and payload["truncated_to"] == 10
+    store.close()
+
+
+def test_segmentation_confusion_capped():
+    from mlcomp_tpu.report.artifacts import segmentation_report_from_confusion
+
+    big = np.eye(100, dtype=np.int64)
+    rep = segmentation_report_from_confusion(big)
+    assert rep["confusion"] is None and len(rep["per_class"]) == 100
+
+
+def test_legacy_store_schema_migrates_to_nullable_metrics(tmp_path):
+    """Old DBs with metrics.value NOT NULL are rebuilt on open."""
+    import sqlite3
+
+    path = str(tmp_path / "legacy.sqlite")
+    conn = sqlite3.connect(path)
+    conn.executescript(
+        """
+        CREATE TABLE metrics (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            task_id INTEGER NOT NULL, ts REAL NOT NULL,
+            name TEXT NOT NULL, step INTEGER NOT NULL DEFAULT 0,
+            value REAL NOT NULL
+        );
+        INSERT INTO metrics (task_id, ts, name, step, value)
+            VALUES (1, 0.0, 'loss', 0, 0.5);
+        """
+    )
+    conn.commit()
+    conn.close()
+    store = Store(path)
+    store.metric(1, "loss", float("nan"), step=1)  # legacy schema would raise
+    assert store.metric_series(1, "loss") == [(0, 0.5)]
+    store.metric(1, "loss", 0.25, step=2)
+    assert store.metric_series(1, "loss") == [(0, 0.5), (2, 0.25)]
+    store.close()
+
+
+def test_report_all_rows_ignored_keeps_stats(tmp_db, tmp_path):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    rng = np.random.default_rng(0)
+    npz_path = str(tmp_path / "v.npz")
+    np.savez(npz_path, x=rng.random((16, 8), dtype=np.float32),
+             y=np.full(16, 7))  # every label ignored
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {"valid": {"name": "npz", "path": npz_path, "batch_size": 8}},
+        "report": {"kind": "classification", "ignore_label": 7},
+    }
+    ok, res, err = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="v",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    assert store.reports(tid) == []
+    msgs = [l["message"] for l in store.task_logs(tid)]
+    assert any("no eligible samples" in m for m in msgs), msgs
+    store.close()
+
+
+def test_seg_ignore_label_does_not_widen_confusion(tmp_db, tmp_path):
+    """Pre-argmaxed masks with 255 void labels keep the true class count."""
+    from mlcomp_tpu.executors.infer import _widened_sum  # noqa: F401
+    from mlcomp_tpu.report.artifacts import segmentation_report
+
+    y_true = np.array([[[0, 1], [255, 2]]])
+    y_pred = np.array([[[0, 1], [0, 2]]])
+    rep = segmentation_report(y_true, y_pred, ignore_label=255)
+    assert len(rep["confusion"]) == 3

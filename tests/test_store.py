@@ -88,3 +88,45 @@ def test_worker_heartbeat_and_death(tmp_db):
     assert store.dead_workers(timeout_s=0.01) == ["w1"]
     store.mark_worker_dead("w1")
     assert store.dead_workers(timeout_s=0.01) == []
+
+
+def test_metric_nan_stored_as_null_and_filtered(tmp_db):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="noop"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    store.metric(tid, "loss", 1.0, step=0)
+    store.metric(tid, "loss", float("nan"), step=1)
+    store.metric(tid, "loss", float("inf"), step=2)
+    store.metric(tid, "loss", 0.5, step=3)
+    assert store.metric_series(tid, "loss") == [(0, 1.0), (3, 0.5)]
+    store.close()
+
+
+def test_add_report_sanitizes_nonfinite(tmp_db):
+    import json as _json
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="noop"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    rid = store.add_report(
+        tid, "r",
+        {"kind": "classification", "accuracy": float("nan"),
+         "worst": [{"confidence": float("inf")}], "ok": 1.5},
+    )
+    raw = store._conn.execute(
+        "SELECT payload FROM reports WHERE id=?", (rid,)
+    ).fetchone()["payload"]
+    payload = _json.loads(raw)  # spec-compliant JSON (no bare NaN)
+    assert payload["accuracy"] is None
+    assert payload["worst"][0]["confidence"] is None
+    assert payload["ok"] == 1.5
+    store.close()

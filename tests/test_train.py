@@ -129,3 +129,67 @@ def test_lr_schedule():
     tr = Trainer(cfg)
     stats = tr.train_epoch()
     assert np.isfinite(stats["loss"])
+
+
+def test_cross_entropy_ignores_out_of_range_labels():
+    """torch ignore_index semantics: labels outside [0, C) drop out."""
+    import jax.numpy as jnp
+    from mlcomp_tpu.train.losses import create_loss
+
+    ce = create_loss("cross_entropy")
+    logits = jnp.asarray([[2.0, 0.0, 0.0], [0.0, 2.0, 0.0], [0.0, 0.0, 2.0]])
+    full = ce(logits[:2], {"y": jnp.asarray([0, 1])})
+    with_ignored = ce(logits, {"y": jnp.asarray([0, 1, 9])})
+    assert float(full) == pytest.approx(float(with_ignored), rel=1e-6)
+    neg = ce(logits, {"y": jnp.asarray([0, 1, -1])})
+    assert float(full) == pytest.approx(float(neg), rel=1e-6)
+
+
+def test_pixel_cross_entropy_ignores_void_pixels():
+    import jax.numpy as jnp
+    from mlcomp_tpu.train.losses import create_loss
+
+    pce = create_loss("pixel_cross_entropy")
+    logits = jnp.zeros((1, 2, 2, 3)).at[..., 0].set(2.0)
+    y = jnp.asarray([[[0, 0], [255, -1]]])
+    loss = pce(logits, {"y": y})
+    y_clean = jnp.asarray([[[0, 0], [0, 0]]])
+    loss_clean = pce(logits, {"y": y_clean})
+    assert float(loss) == pytest.approx(float(loss_clean), rel=1e-6)
+
+
+def test_metrics_ignore_out_of_range_labels():
+    import jax.numpy as jnp
+    from mlcomp_tpu.train.metrics import create_metrics
+
+    acc = create_metrics(["accuracy"])["accuracy"]
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 2.0], [2.0, 0.0]])
+    # third label is void: metric must equal the 2-sample accuracy
+    full = acc(logits[:2], {"y": jnp.asarray([0, 1])})
+    with_void = acc(logits, {"y": jnp.asarray([0, 1, 255])})
+    assert float(full) == pytest.approx(float(with_void))
+
+    pacc = create_metrics(["pixel_accuracy"])["pixel_accuracy"]
+    out = jnp.zeros((1, 2, 2, 3)).at[..., 0].set(2.0)
+    clean = pacc(out, {"y": jnp.asarray([[[0, 0], [0, 0]]])})
+    voided = pacc(out, {"y": jnp.asarray([[[0, 0], [255, -1]]])})
+    assert float(clean) == pytest.approx(float(voided)) == pytest.approx(1.0)
+
+
+def test_dice_and_smoothed_ce_ignore_void_labels():
+    import jax.numpy as jnp
+    from mlcomp_tpu.train.losses import create_loss
+
+    dice = create_loss("dice")
+    logits = jnp.zeros((1, 2, 2, 3)).at[..., 0].set(3.0)
+    clean = dice(logits, {"y": jnp.asarray([[[0, 0], [0, 0]]])})
+    # voiding half the pixels must not blow up the loss: excluded pixels
+    # contribute to neither prediction nor target mass
+    voided = dice(logits, {"y": jnp.asarray([[[0, 0], [255, -1]]])})
+    assert float(voided) == pytest.approx(float(clean), abs=1e-4)
+
+    sce = create_loss("smoothed_cross_entropy")
+    lg = jnp.asarray([[3.0, 0.0, 0.0], [0.0, 3.0, 0.0], [3.0, 0.0, 0.0]])
+    full = sce(lg[:2], {"y": jnp.asarray([0, 1])})
+    with_void = sce(lg, {"y": jnp.asarray([0, 1, 255])})
+    assert float(full) == pytest.approx(float(with_void), rel=1e-6)
