@@ -127,7 +127,17 @@ def main(argv=None) -> int:
     r.set_defaults(fn=_cmd_report)
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    from mlcomp_tpu.dag.graph import DagValidationError
+    from mlcomp_tpu.utils.config import ConfigError
+
+    try:
+        return args.fn(args)
+    except (DagValidationError, ConfigError) as e:
+        # user config errors: one clear line, no traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except KeyboardInterrupt:
+        return 130
 
 
 if __name__ == "__main__":

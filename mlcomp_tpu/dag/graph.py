@@ -7,6 +7,7 @@ behavior: BASELINE.json:5 — "Supervisor/Worker scheduler").
 
 from __future__ import annotations
 
+import os
 from collections import deque
 from typing import Dict, Iterable, List, Mapping, Set
 
@@ -32,6 +33,68 @@ def validate_dag(dag: DagSpec) -> None:
             if d == t.name:
                 raise DagValidationError(f"task {t.name!r} depends on itself")
     topo_sort(dag.tasks)  # raises on cycle
+    races = detect_write_races(dag.tasks)
+    if races:
+        raise DagValidationError(
+            "write-write races (same output path, no dependency ordering): "
+            + "; ".join(races)
+        )
+
+
+#: task-arg keys that declare an output location the task will write.
+#: NOTE: ``ckpt_dir`` is deliberately absent — executors treat it as a
+#: read-only restore source (executors/infer.py), and parallel readers of
+#: one checkpoint are the normal fan-out pattern, not a race.
+_OUTPUT_KEYS = ("out",)
+
+
+def detect_write_races(tasks: Iterable[TaskSpec]) -> List[str]:
+    """Static data-race detector over declared output paths.
+
+    Two tasks that can run CONCURRENTLY (no dependency path between them)
+    and declare the same output location (``out`` arg) race on the
+    filesystem — the classic scheduler hazard the aux race-detection
+    subsystem exists to catch before any worker runs.  Ordered writers
+    (one is a transitive dependency of the other) are allowed: overwrite
+    is deliberate staging there.
+    """
+    tasks = list(tasks)
+    writers: Dict[str, List[str]] = {}
+    for t in tasks:
+        # set: a task writing one path under several keys isn't self-racing
+        for path in {
+            os.path.normpath(t.args[key])
+            for key in _OUTPUT_KEYS
+            if isinstance(t.args.get(key), str) and t.args[key]
+        }:
+            writers.setdefault(path, []).append(t.name)
+
+    collisions = {p: ns for p, ns in writers.items() if len(ns) > 1}
+    if not collisions:
+        return []
+
+    # ancestor sets only for colliding tasks (BFS up the dependency edges)
+    by_name = {t.name: t for t in tasks}
+
+    def ancestors(name: str) -> Set[str]:
+        seen: Set[str] = set()
+        stack = list(by_name[name].depends)
+        while stack:
+            d = stack.pop()
+            if d in seen:
+                continue
+            seen.add(d)
+            stack.extend(by_name[d].depends)
+        return seen
+
+    races = []
+    for path, names in sorted(collisions.items()):
+        anc = {n: ancestors(n) for n in names}
+        for i, a in enumerate(names):
+            for b in names[i + 1 :]:
+                if a not in anc[b] and b not in anc[a]:
+                    races.append(f"{a!r} and {b!r} both write {path!r}")
+    return races
 
 
 def topo_sort(tasks: Iterable[TaskSpec]) -> List[TaskSpec]:
@@ -73,6 +136,71 @@ def ready_tasks(
         if all(statuses.get(d) == TaskStatus.SUCCESS for d in t.depends):
             out.append(t)
     return out
+
+
+class DagAnalyzer:
+    """Per-DAG scheduling analysis with a native fast path.
+
+    Builds the dependency CSR once (task sets are immutable after submit),
+    then each ``analyze`` call returns ``(ready, doomed)`` in one
+    O(V+E) native pass (native/schedcore.cpp) — the Python walk below is
+    the always-available fallback with identical semantics (property-tested
+    against each other in tests/test_native.py).  Ready tasks come back
+    sorted by (-priority, submission order)."""
+
+    def __init__(self, tasks: Iterable[TaskSpec]):
+        self.tasks = list(tasks)
+        self._index = {t.name: i for i, t in enumerate(self.tasks)}
+        index = self._index
+        offsets = [0]
+        deps: List[int] = []
+        for t in self.tasks:
+            deps.extend(index[d] for d in t.depends)
+            offsets.append(len(deps))
+        import numpy as np
+
+        self._dep_off = np.asarray(offsets, dtype=np.int64)
+        self._deps = np.asarray(deps, dtype=np.int64)
+        self._prio = np.asarray(
+            [t.resources.priority for t in self.tasks], dtype=np.int64
+        )
+
+    _STATUS_CODE = {
+        TaskStatus.NOT_RAN: 0,
+        TaskStatus.SUCCESS: 2,
+        TaskStatus.FAILED: 3,
+        TaskStatus.SKIPPED: 3,
+        TaskStatus.STOPPED: 3,
+    }
+
+    def analyze(
+        self, statuses: Mapping[str, TaskStatus]
+    ) -> tuple[List[TaskSpec], Set[str]]:
+        from mlcomp_tpu import native
+
+        import numpy as np
+
+        status = np.asarray(
+            [
+                self._STATUS_CODE.get(
+                    statuses.get(t.name, TaskStatus.NOT_RAN), 1
+                )
+                for t in self.tasks
+            ],
+            dtype=np.int8,
+        )
+        res = native.dag_analyze(self._dep_off, self._deps, status, self._prio)
+        if res is None:  # no toolchain / stale lib — Python fallback
+            ready = sorted(
+                ready_tasks(self.tasks, statuses),
+                key=lambda t: (-t.resources.priority, self._index[t.name]),
+            )
+            return ready, doomed_tasks(self.tasks, statuses)
+        ready_idx, doomed_idx = res
+        return (
+            [self.tasks[i] for i in ready_idx],
+            {self.tasks[i].name for i in doomed_idx},
+        )
 
 
 def doomed_tasks(

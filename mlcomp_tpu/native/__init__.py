@@ -20,7 +20,7 @@ from typing import Optional
 import numpy as np
 
 _DIR = Path(__file__).resolve().parent
-_SRC = _DIR / "dataops.cpp"
+_SRCS = [_DIR / "dataops.cpp", _DIR / "schedcore.cpp"]
 _SO = _DIR / "libmlcdata.so"
 
 _lock = threading.Lock()
@@ -31,7 +31,7 @@ _tried = False
 def _build() -> bool:
     cmd = [
         "g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
-        str(_SRC), "-o", str(_SO),
+        *[str(s) for s in _SRCS], "-o", str(_SO),
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -51,7 +51,9 @@ def lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("MLCOMP_TPU_NO_NATIVE"):
             return None
-        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+        if not _SO.exists() or any(
+            _SO.stat().st_mtime < s.stat().st_mtime for s in _SRCS
+        ):
             if not _build():
                 return None
         try:
@@ -64,6 +66,15 @@ def lib() -> Optional[ctypes.CDLL]:
         ]
         l.mlc_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         l.mlc_iota.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        try:  # stale pre-schedcore .so (mtime check should rebuild, but be safe)
+            l.mlc_dag_analyze.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            l.mlc_dag_analyze.restype = ctypes.c_int64
+        except AttributeError:
+            pass
         _lib = l
         return _lib
 
@@ -97,3 +108,31 @@ def shuffled_indices(n: int, seed: int) -> Optional[np.ndarray]:
     l.mlc_iota(idx.ctypes.data, n)
     l.mlc_shuffle(idx.ctypes.data, n, np.uint64(seed & (2**64 - 1)))
     return idx
+
+
+def dag_analyze(dep_offsets, deps, status, priority):
+    """One-pass ready-set + doom propagation over a dependency CSR.
+
+    Returns ``(ready_indices, doomed_indices)`` (numpy int64 arrays) or
+    None when the native library is unavailable or the graph is cyclic —
+    callers fall back to the Python graph walk (dag/graph.py).
+    """
+    l = lib()
+    if l is None or not hasattr(l, "mlc_dag_analyze"):
+        return None
+    dep_offsets = np.ascontiguousarray(dep_offsets, dtype=np.int64)
+    deps = np.ascontiguousarray(deps, dtype=np.int64)
+    status = np.ascontiguousarray(status, dtype=np.int8)
+    priority = np.ascontiguousarray(priority, dtype=np.int64)
+    n = len(status)
+    ready = np.empty(n, dtype=np.int64)
+    doomed = np.empty(n, dtype=np.int64)
+    n_doomed = np.zeros(1, dtype=np.int64)
+    n_ready = l.mlc_dag_analyze(
+        n, dep_offsets.ctypes.data, deps.ctypes.data, status.ctypes.data,
+        priority.ctypes.data, ready.ctypes.data, doomed.ctypes.data,
+        n_doomed.ctypes.data,
+    )
+    if n_ready < 0:
+        return None
+    return ready[:n_ready].copy(), doomed[: n_doomed[0]].copy()

@@ -19,7 +19,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
-from mlcomp_tpu.dag.graph import doomed_tasks, ready_tasks
+from mlcomp_tpu.dag.graph import DagAnalyzer
 from mlcomp_tpu.dag.schema import TaskStatus
 from mlcomp_tpu.db.store import Store
 
@@ -41,6 +41,8 @@ class Supervisor:
             if notifiers and isinstance(notifiers[0], dict)
             else list(notifiers or [])
         )
+        # task sets are immutable after submit; one CSR build per DAG
+        self._analyzers: Dict[int, DagAnalyzer] = {}
 
     def _notify(self, event: str, **detail) -> None:
         import logging
@@ -58,20 +60,31 @@ class Supervisor:
         """One scheduling pass over all live DAGs; returns dag_id → status."""
         self._reap_dead_workers()
         out: Dict[int, str] = {}
+        live = set()
         for dag in self.store.list_dags():
             if dag["status"] != "in_progress":
                 out[dag["id"]] = dag["status"]
                 continue
+            live.add(dag["id"])
             out[dag["id"]] = self._advance_dag(dag["id"])
+        # evict analyzers for DAGs finished elsewhere (a concurrent replica
+        # may finalize a DAG this replica never advances again)
+        for dag_id in list(self._analyzers):
+            if dag_id not in live:
+                del self._analyzers[dag_id]
         return out
 
     def _advance_dag(self, dag_id: int) -> str:
-        specs = self.store.task_specs(dag_id)
+        analyzer = self._analyzers.get(dag_id)
+        if analyzer is None:
+            analyzer = self._analyzers[dag_id] = DagAnalyzer(
+                self.store.task_specs(dag_id)
+            )
         statuses = self.store.task_statuses(dag_id)
 
         # Conditional transitions (expect=NOT_RAN) keep concurrent supervisor
         # replicas with stale snapshots from re-queueing finished work.
-        ready = ready_tasks(specs, statuses)
+        ready, doomed = analyzer.analyze(statuses)
         if ready:
             self.store.set_task_status(
                 dag_id,
@@ -79,7 +92,6 @@ class Supervisor:
                 TaskStatus.QUEUED,
                 expect=TaskStatus.NOT_RAN,
             )
-        doomed = doomed_tasks(specs, statuses)
         if doomed:
             self.store.set_task_status(
                 dag_id, doomed, TaskStatus.SKIPPED, expect=TaskStatus.NOT_RAN
@@ -101,6 +113,7 @@ class Supervisor:
                     status=final,
                     tasks={n: s.value for n, s in statuses.items()},
                 )
+            self._analyzers.pop(dag_id, None)  # finished: drop the CSR cache
             return final
         return "in_progress"
 

@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional
 from mlcomp_tpu.dag.schema import TaskStatus
 from mlcomp_tpu.db.store import Store
 from mlcomp_tpu.executors.base import ExecutionContext, run_task
+from mlcomp_tpu.utils.faults import inject
 
 
 def default_worker_name() -> str:
@@ -98,6 +99,7 @@ class Worker:
         )
         if claim is None:
             return False
+        inject("worker.after_claim")  # no-op unless a recovery test armed it
         self.store.heartbeat(self.name, self.chips, busy_chips=claim["chips"])
         stop = threading.Event()
         pump = threading.Thread(
@@ -129,6 +131,7 @@ class Worker:
         finally:
             stop.set()
             pump.join(timeout=self.heartbeat_interval_s + 1.0)
+        inject("worker.before_finish")  # executor done, result not yet stored
         # expect_worker guards against a reaped-and-requeued task being
         # clobbered by this (stale) worker finishing late.
         if ok:

@@ -1,0 +1,83 @@
+"""Fault injection: deterministic crash/raise points for recovery testing.
+
+The scheduler's failure-detection contract (heartbeat reaping, retry
+budgets, conditional status transitions) is only trustworthy if it is
+exercised against real mid-flight deaths.  This module provides named
+injection points the runtime calls at its state-transition edges; tests
+(or a chaos run) arm them either programmatically (``arm``) or through
+``MLCOMP_FAULTS`` in a subprocess's environment.
+
+Flavors:
+- ``raise``  — raise ``FaultInjected`` (exception path: executor failure)
+- ``kill``   — ``os._exit(137)`` (hard process death: no cleanup, no
+  finally blocks — what a OOM-kill or preemption looks like)
+
+``MLCOMP_FAULTS`` syntax: ``point[:flavor][:times]`` comma-separated,
+e.g. ``worker.before_finish:kill:1,supervisor.tick:raise``.
+``times`` bounds how often the point fires (default 1; ``*`` = always).
+
+Points are no-ops unless armed — zero overhead in production paths beyond
+a dict lookup.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Tuple
+
+__all__ = ["FaultInjected", "arm", "disarm_all", "inject"]
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``raise``-flavor injection point."""
+
+
+_lock = threading.Lock()
+# point -> (flavor, remaining) ; remaining < 0 means unlimited
+_armed: Dict[str, Tuple[str, int]] = {}
+_env_loaded = False
+
+
+def _load_env() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get("MLCOMP_FAULTS", "")
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        parts = item.split(":")
+        point = parts[0]
+        flavor = parts[1] if len(parts) > 1 else "raise"
+        times = parts[2] if len(parts) > 2 else "1"
+        _armed[point] = (flavor, -1 if times == "*" else int(times))
+
+
+def arm(point: str, flavor: str = "raise", times: int = 1) -> None:
+    """Arm ``point`` to fire ``times`` times with ``flavor``."""
+    if flavor not in ("raise", "kill"):
+        raise ValueError(f"unknown fault flavor {flavor!r}")
+    with _lock:
+        _armed[point] = (flavor, times)
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
+
+
+def inject(point: str) -> None:
+    """Fire ``point`` if armed; called by the runtime at transition edges."""
+    _load_env()
+    with _lock:
+        entry = _armed.get(point)
+        if entry is None:
+            return
+        flavor, remaining = entry
+        if remaining == 0:
+            return
+        if remaining > 0:
+            _armed[point] = (flavor, remaining - 1)
+    if flavor == "kill":
+        os._exit(137)
+    raise FaultInjected(f"injected fault at {point!r}")

@@ -1,0 +1,196 @@
+"""Failure handling: static race detection + fault-injected crash recovery."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mlcomp_tpu.dag.graph import DagValidationError, detect_write_races, validate_dag
+from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.scheduler.supervisor import Supervisor
+from mlcomp_tpu.scheduler.worker import Worker
+from mlcomp_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm_all()
+
+
+# ---------------------------------------------------------------- races
+
+
+def test_race_detector_flags_concurrent_writers():
+    tasks = [
+        TaskSpec(name="a", executor="noop", args={"out": "preds.npz"}),
+        TaskSpec(name="b", executor="noop", args={"out": "./preds.npz"}),
+    ]
+    races = detect_write_races(tasks)
+    assert len(races) == 1 and "'a'" in races[0] and "'b'" in races[0]
+    with pytest.raises(DagValidationError, match="race"):
+        validate_dag(DagSpec(name="d", project="p", tasks=tuple(tasks)))
+
+
+def test_race_detector_allows_ordered_writers():
+    tasks = [
+        TaskSpec(name="a", executor="noop", args={"out": "x.npz"}),
+        TaskSpec(name="mid", executor="noop", depends=("a",)),
+        TaskSpec(name="b", executor="noop", depends=("mid",), args={"out": "x.npz"}),
+    ]
+    assert detect_write_races(tasks) == []
+    validate_dag(DagSpec(name="d", project="p", tasks=tuple(tasks)))
+
+
+def test_race_detector_distinct_paths_ok():
+    tasks = [
+        TaskSpec(name="a", executor="noop", args={"out": "a.npz"}),
+        TaskSpec(name="b", executor="noop", args={"ckpt_dir": "ck/b"}),
+    ]
+    assert detect_write_races(tasks) == []
+
+
+# ------------------------------------------------------------ fault arming
+
+
+def test_inject_noop_when_unarmed():
+    faults.inject("worker.after_claim")  # must not raise
+
+
+def test_arm_raise_fires_limited_times():
+    faults.arm("p", times=2)
+    with pytest.raises(faults.FaultInjected):
+        faults.inject("p")
+    with pytest.raises(faults.FaultInjected):
+        faults.inject("p")
+    faults.inject("p")  # budget spent
+
+
+# --------------------------------------------------- crash recovery (raise)
+
+
+def _submit_noop(store, max_retries=1):
+    dag_id = store.submit_dag(
+        DagSpec(
+            name="d",
+            project="p",
+            tasks=(TaskSpec(name="t", executor="noop", max_retries=max_retries),),
+        )
+    )
+    return dag_id, store.task_rows(dag_id)[0]["id"]
+
+
+def test_worker_crash_after_claim_recovers_via_reap(tmp_db):
+    """A worker that dies after claiming leaves the task in_progress; the
+    supervisor's failure detector requeues it and a healthy worker finishes."""
+    store = Store(tmp_db)
+    dag_id, tid = _submit_noop(store)
+    sup = Supervisor(store, worker_timeout_s=0.05)
+    sup.tick()  # queue the task
+    assert store.task_statuses(dag_id)["t"] == TaskStatus.QUEUED
+
+    faults.arm("worker.after_claim", flavor="raise")
+    w = Worker(store, name="doomed", chips=0, load_jax_executors=False)
+    from mlcomp_tpu.executors import load_all
+
+    load_all()
+    with pytest.raises(faults.FaultInjected):
+        w.run_once()
+    # task stranded in_progress on the dead worker
+    assert store.task_statuses(dag_id)["t"] == TaskStatus.IN_PROGRESS
+
+    time.sleep(0.1)  # let the heartbeat go stale
+    sup.tick()  # failure detector: reap + requeue (retry budget 1)
+    assert store.task_statuses(dag_id)["t"] == TaskStatus.QUEUED
+
+    w2 = Worker(store, name="healthy", chips=0, load_jax_executors=False)
+    assert w2.run_once()
+    assert store.task_statuses(dag_id)["t"] == TaskStatus.SUCCESS
+    assert sup.tick()[dag_id] == "success"
+    store.close()
+
+
+def test_worker_crash_retries_exhausted_fails_task(tmp_db):
+    store = Store(tmp_db)
+    dag_id, tid = _submit_noop(store, max_retries=0)
+    sup = Supervisor(store, worker_timeout_s=0.05)
+    sup.tick()
+    faults.arm("worker.after_claim", flavor="raise")
+    w = Worker(store, name="doomed", chips=0, load_jax_executors=False)
+    from mlcomp_tpu.executors import load_all
+
+    load_all()
+    with pytest.raises(faults.FaultInjected):
+        w.run_once()
+    time.sleep(0.1)
+    sup.tick()
+    assert store.task_statuses(dag_id)["t"] == TaskStatus.FAILED
+    assert sup.tick()[dag_id] == "failed"
+    store.close()
+
+
+# ---------------------------------------------------- crash recovery (kill)
+
+
+_KILL_WORKER = """
+import sys
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.scheduler.worker import Worker
+from mlcomp_tpu.executors import load_all
+load_all()
+store = Store(sys.argv[1])
+w = Worker(store, name="killed", chips=0, load_jax_executors=False)
+w.run_once()
+print("survived")  # must be unreachable with the kill fault armed
+"""
+
+
+def test_hard_kill_mid_task_recovers(tmp_db):
+    """os._exit(137) between executor completion and finish_task: the task
+    result is lost, the supervisor reaps the silent worker, and a retry
+    lands the result — the preemption/OOM-kill story end to end."""
+    store = Store(tmp_db)
+    dag_id, tid = _submit_noop(store)
+    Supervisor(store, worker_timeout_s=0.05).tick()
+
+    env = dict(os.environ)
+    env["MLCOMP_FAULTS"] = "worker.before_finish:kill:1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_WORKER, tmp_db],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 137, proc.stderr
+    assert "survived" not in proc.stdout
+    assert store.task_statuses(dag_id)["t"] == TaskStatus.IN_PROGRESS
+
+    time.sleep(0.1)
+    sup = Supervisor(store, worker_timeout_s=0.05)
+    sup.tick()
+    assert store.task_statuses(dag_id)["t"] == TaskStatus.QUEUED
+
+    from mlcomp_tpu.executors import load_all
+
+    load_all()
+    w = Worker(store, name="healthy", chips=0, load_jax_executors=False)
+    assert w.run_once()
+    assert sup.tick()[dag_id] == "success"
+    store.close()
+
+
+def test_parallel_readers_of_checkpoint_not_a_race():
+    """ckpt_dir is a restore INPUT: val+test fan-out sharing one checkpoint
+    must validate (regression: ckpt_dir was once treated as an output)."""
+    tasks = [
+        TaskSpec(name="train", executor="noop"),
+        TaskSpec(name="val", executor="noop", depends=("train",),
+                 args={"ckpt_dir": "ck/train"}),
+        TaskSpec(name="test", executor="noop", depends=("train",),
+                 args={"ckpt_dir": "ck/train"}),
+    ]
+    assert detect_write_races(tasks) == []
+    validate_dag(DagSpec(name="d", project="p", tasks=tuple(tasks)))

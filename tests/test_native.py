@@ -56,3 +56,91 @@ def test_loader_uses_native_gather(built):
         seen.extend(np.asarray(batch["y"]).tolist())
     # rows come from the dataset, shuffled, no duplicates within epoch
     assert len(seen) == 32 and len(set(seen)) == 32
+
+
+def _random_dag_case(rng, n):
+    """Random DAG (edges only point backward) + random statuses."""
+    from mlcomp_tpu.dag.schema import ResourceSpec, TaskSpec, TaskStatus
+
+    tasks = []
+    for i in range(n):
+        k = rng.integers(0, min(i, 3) + 1)
+        deps = rng.choice(i, size=k, replace=False) if i and k else []
+        tasks.append(
+            TaskSpec(
+                name=f"t{i}",
+                executor="noop",
+                depends=tuple(f"t{int(d)}" for d in deps),
+                resources=ResourceSpec(priority=int(rng.integers(0, 5))),
+            )
+        )
+    pool = [
+        TaskStatus.NOT_RAN, TaskStatus.QUEUED, TaskStatus.IN_PROGRESS,
+        TaskStatus.SUCCESS, TaskStatus.FAILED, TaskStatus.SKIPPED,
+        TaskStatus.STOPPED,
+    ]
+    statuses = {t.name: pool[int(rng.integers(0, len(pool)))] for t in tasks}
+    return tasks, statuses
+
+
+def test_dag_analyze_matches_python_walk(built):
+    """Property test: native one-pass analysis == Python ready/doomed walk."""
+    from mlcomp_tpu.dag.graph import DagAnalyzer, doomed_tasks, ready_tasks
+
+    rng = np.random.default_rng(0)
+    for trial in range(25):
+        tasks, statuses = _random_dag_case(rng, int(rng.integers(1, 40)))
+        analyzer = DagAnalyzer(tasks)
+        ready, doomed = analyzer.analyze(statuses)
+        py_ready = {t.name for t in ready_tasks(tasks, statuses)}
+        py_doomed = doomed_tasks(tasks, statuses)
+        assert {t.name for t in ready} == py_ready, trial
+        assert doomed == py_doomed, trial
+        # ready ordering: priority strictly descending
+        prios = [t.resources.priority for t in ready]
+        assert prios == sorted(prios, reverse=True), (trial, prios)
+
+
+def test_dag_analyze_priority_order(built):
+    from mlcomp_tpu.dag.schema import ResourceSpec, TaskSpec, TaskStatus
+    from mlcomp_tpu.dag.graph import DagAnalyzer
+
+    tasks = [
+        TaskSpec(name="lo", executor="noop", resources=ResourceSpec(priority=1)),
+        TaskSpec(name="hi", executor="noop", resources=ResourceSpec(priority=9)),
+        TaskSpec(name="mid", executor="noop", resources=ResourceSpec(priority=5)),
+    ]
+    ready, doomed = DagAnalyzer(tasks).analyze(
+        {t.name: TaskStatus.NOT_RAN for t in tasks}
+    )
+    assert [t.name for t in ready] == ["hi", "mid", "lo"] and not doomed
+
+
+def test_dag_analyze_doom_propagates_transitively(built):
+    from mlcomp_tpu.dag.schema import TaskSpec, TaskStatus
+    from mlcomp_tpu.dag.graph import DagAnalyzer
+
+    tasks = [
+        TaskSpec(name="a", executor="noop"),
+        TaskSpec(name="b", executor="noop", depends=("a",)),
+        TaskSpec(name="c", executor="noop", depends=("b",)),
+        TaskSpec(name="d", executor="noop", depends=("c",)),
+    ]
+    ready, doomed = DagAnalyzer(tasks).analyze(
+        {"a": TaskStatus.FAILED, "b": TaskStatus.NOT_RAN,
+         "c": TaskStatus.NOT_RAN, "d": TaskStatus.NOT_RAN}
+    )
+    assert doomed == {"b", "c", "d"} and not ready
+
+
+def test_dag_analyze_native_actually_engaged(built):
+    """The native path (not the fallback) is what runs when the lib built."""
+    lib = native.lib()
+    assert hasattr(lib, "mlc_dag_analyze")
+    res = native.dag_analyze(
+        np.array([0, 0, 1]), np.array([0]), np.array([2, 0], dtype=np.int8),
+        np.array([0, 0]),
+    )
+    assert res is not None
+    ready, doomed = res
+    assert ready.tolist() == [1] and doomed.tolist() == []
