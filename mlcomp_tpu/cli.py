@@ -54,6 +54,55 @@ def _cmd_submit(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_status(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.db.store import Store
+
+    store = Store(args.db)
+    try:
+        dags = store.list_dags()
+        if args.dag is not None:
+            rows = store.task_rows(args.dag)
+            for r in rows:
+                line = f"  {r['id']:>4} {r['name']:<28} {r['status']:<12}"
+                if r["worker"]:
+                    line += f" worker={r['worker']}"
+                if r["error"]:
+                    line += f" error={r['error'].splitlines()[-1][:60]}"
+                print(line)
+            return 0
+        for d in dags:
+            counts: dict = {}
+            for s in store.task_statuses(d["id"]).values():
+                counts[s.value] = counts.get(s.value, 0) + 1
+            print(
+                f"{d['id']:>4} {d['name']:<20} {d['project']:<12}"
+                f" {d['status']:<12} {counts}"
+            )
+        return 0
+    finally:
+        store.close()
+
+
+def _cmd_stop(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.db.store import Store
+
+    store = Store(args.db)
+    n = store.stop_dag(args.dag)
+    store.close()
+    print(json.dumps({"dag_id": args.dag, "stopped_tasks": n}))
+    return 0
+
+
+def _cmd_restart(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.db.store import Store
+
+    store = Store(args.db)
+    n = store.restart_dag(args.dag)
+    store.close()
+    print(json.dumps({"dag_id": args.dag, "reset_tasks": n}))
+    return 0
+
+
 def _cmd_supervisor(args: argparse.Namespace) -> int:
     from mlcomp_tpu.scheduler.supervisor import Supervisor
     from mlcomp_tpu.db.store import Store
@@ -101,6 +150,21 @@ def main(argv=None) -> int:
     sb.add_argument("config")
     sb.add_argument("--db", default="mlcomp.sqlite")
     sb.set_defaults(fn=_cmd_submit)
+
+    st = sub.add_parser("status", help="list DAGs, or tasks of one DAG")
+    st.add_argument("dag", nargs="?", type=int, default=None)
+    st.add_argument("--db", default="mlcomp.sqlite")
+    st.set_defaults(fn=_cmd_status)
+
+    sp = sub.add_parser("stop", help="stop a DAG (unfinished tasks -> stopped)")
+    sp.add_argument("dag", type=int)
+    sp.add_argument("--db", default="mlcomp.sqlite")
+    sp.set_defaults(fn=_cmd_stop)
+
+    rs = sub.add_parser("restart", help="re-run a DAG's unsuccessful tasks")
+    rs.add_argument("dag", type=int)
+    rs.add_argument("--db", default="mlcomp.sqlite")
+    rs.set_defaults(fn=_cmd_restart)
 
     s = sub.add_parser("supervisor", help="run the supervisor daemon")
     s.add_argument("--db", default="mlcomp.sqlite")

@@ -240,6 +240,62 @@ class Store:
                 )
             return cur.rowcount > 0
 
+    def stop_dag(self, dag_id: int) -> int:
+        """Stop a DAG: every unfinished task goes STOPPED and the DAG is
+        finalized as 'stopped'.  A worker mid-task keeps computing, but its
+        late ``finish_task(expect_worker=...)`` is a conditional update on
+        status=in_progress, so the stop cannot be clobbered.  Returns the
+        number of tasks transitioned."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE tasks SET status=?, finished=? WHERE dag_id=?"
+                " AND status IN (?,?,?)",
+                (
+                    TaskStatus.STOPPED.value,
+                    time.time(),
+                    dag_id,
+                    TaskStatus.NOT_RAN.value,
+                    TaskStatus.QUEUED.value,
+                    TaskStatus.IN_PROGRESS.value,
+                ),
+            )
+            c.execute(
+                "UPDATE dags SET status='stopped' WHERE id=? AND"
+                " status='in_progress'",
+                (dag_id,),
+            )
+            return cur.rowcount
+
+    def restart_dag(self, dag_id: int) -> int:
+        """Re-run a finished/stopped DAG's unsuccessful tasks.
+
+        FAILED/SKIPPED/STOPPED tasks reset to NOT_RAN with a fresh retry
+        budget; SUCCESS tasks keep their results (their dependents see
+        satisfied deps immediately).  The DAG returns to in_progress and
+        the Supervisor re-queues from there.  Returns tasks reset."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE tasks SET status=?, worker=NULL, started=NULL,"
+                " finished=NULL, error=NULL, retries=0 WHERE dag_id=?"
+                " AND status IN (?,?,?)",
+                (
+                    TaskStatus.NOT_RAN.value,
+                    dag_id,
+                    TaskStatus.FAILED.value,
+                    TaskStatus.SKIPPED.value,
+                    TaskStatus.STOPPED.value,
+                ),
+            )
+            # always reopen a stopped/failed DAG, even with zero tasks to
+            # reset (e.g. stopped after every task already succeeded) —
+            # the supervisor only finalizes in_progress DAGs
+            c.execute(
+                "UPDATE dags SET status='in_progress' WHERE id=?"
+                " AND status IN ('stopped','failed')",
+                (dag_id,),
+            )
+            return cur.rowcount
+
     def list_dags(self) -> List[Dict[str, Any]]:
         rows = self._conn.execute(
             "SELECT id, name, project, status, created FROM dags ORDER BY id"
@@ -368,14 +424,28 @@ class Store:
             cur = c.execute(q, params)
             return cur.rowcount == 1
 
-    def requeue_task(self, task_id: int) -> bool:
-        """Put a task back in the queue, consuming one retry. False if spent."""
+    def requeue_task(self, task_id: int, expect_worker: Optional[str] = None) -> bool:
+        """Put a task back in the queue, consuming one retry. False if spent.
+
+        Only fires while the task is still IN_PROGRESS (a stopped or
+        already-requeued task must not be resurrected by a stale worker);
+        with ``expect_worker`` it additionally requires the task to still
+        be assigned to that worker — the same guard ``finish_task`` has."""
+        q = (
+            "UPDATE tasks SET status=?, worker=NULL, started=NULL,"
+            " retries=retries+1 WHERE id=? AND retries < max_retries"
+            " AND status=?"
+        )
+        params: list = [
+            TaskStatus.QUEUED.value,
+            task_id,
+            TaskStatus.IN_PROGRESS.value,
+        ]
+        if expect_worker is not None:
+            q += " AND worker=?"
+            params.append(expect_worker)
         with self._tx() as c:
-            cur = c.execute(
-                "UPDATE tasks SET status=?, worker=NULL, started=NULL,"
-                " retries=retries+1 WHERE id=? AND retries < max_retries",
-                (TaskStatus.QUEUED.value, task_id),
-            )
+            cur = c.execute(q, params)
             return cur.rowcount == 1
 
     def tasks_on_worker(self, worker: str) -> List[Dict[str, Any]]:

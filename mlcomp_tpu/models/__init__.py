@@ -19,7 +19,7 @@ def load_all() -> None:
 
     import importlib
 
-    for mod in ("resnet", "unet", "bert", "transformer", "moe"):
+    for mod in ("resnet", "unet", "bert", "transformer", "moe", "vit"):
         name = f"mlcomp_tpu.models.{mod}"
         try:
             importlib.import_module(name)

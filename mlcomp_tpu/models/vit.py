@@ -1,0 +1,98 @@
+"""Vision Transformer: patchify with a conv, then the shared encoder.
+
+Rounds out the classification zoo beyond convnets (the reference ships
+torchvision classification models via Catalyst; ViT is today's standard
+member of that family).  TPU-first choices:
+
+- patch embedding as a stride=patch conv (one big MXU matmul per image,
+  no gather);
+- the SAME TransformerLayer as BERT (models/bert.py) — attention runs
+  through ops.attention.dot_product_attention and its Pallas flash path;
+- bfloat16 activations, fp32 layernorm/logits;
+- learned position embeddings; classification via mean pooling (GAP) by
+  default or a CLS token — GAP avoids the sequence-length+1 odd shape on
+  the MXU and performs equivalently at this scale.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+from mlcomp_tpu.models.bert import TransformerLayer
+
+
+@MODELS.register("vit")
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch: int = 16
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    dropout: float = 0.0
+    pool: str = "gap"            # "gap" | "cls"
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = x.astype(dtype)
+        # (B, H, W, C) -> (B, H/p * W/p, hidden): stride-p conv = patch matmul
+        h = nn.Conv(
+            self.hidden,
+            (self.patch, self.patch),
+            strides=(self.patch, self.patch),
+            padding="VALID",
+            dtype=dtype,
+            name="patch_embed",
+        )(x)
+        b, gh, gw, c = h.shape
+        h = h.reshape(b, gh * gw, c)
+
+        if self.pool == "cls":
+            cls = self.param(
+                "cls", nn.initializers.zeros, (1, 1, self.hidden), jnp.float32
+            )
+            h = jnp.concatenate(
+                [jnp.broadcast_to(cls.astype(dtype), (b, 1, c)), h], axis=1
+            )
+        pos = self.param(
+            "pos_emb",
+            nn.initializers.normal(0.02),
+            (h.shape[1], self.hidden),
+            jnp.float32,
+        )
+        h = h + pos[None].astype(dtype)
+
+        for _ in range(self.layers):
+            h = TransformerLayer(
+                self.hidden, self.heads, self.mlp_dim, dtype, self.dropout
+            )(h, train=train)
+        h = nn.LayerNorm(dtype=dtype, param_dtype=jnp.float32)(h)
+        pooled = h[:, 0, :] if self.pool == "cls" else h.mean(axis=1)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(pooled)
+
+
+@MODELS.register("vit_b16")
+def vit_b16(**kw) -> ViT:
+    return ViT(**kw)
+
+
+@MODELS.register("vit_s16")
+def vit_s16(**kw) -> ViT:
+    kw.setdefault("hidden", 384)
+    kw.setdefault("layers", 12)
+    kw.setdefault("heads", 6)
+    kw.setdefault("mlp_dim", 1536)
+    return ViT(**kw)
+
+
+@MODELS.register("vit_tiny")
+def vit_tiny(**kw) -> ViT:
+    kw.setdefault("hidden", 192)
+    kw.setdefault("layers", 4)
+    kw.setdefault("heads", 3)
+    kw.setdefault("mlp_dim", 768)
+    return ViT(**kw)

@@ -25,6 +25,11 @@ from typing import Any, Optional, Tuple
 
 from mlcomp_tpu.db.store import Store
 
+_POST_ROUTES = [
+    (re.compile(r"^/api/dags/(\d+)/stop$"), "stop_dag"),
+    (re.compile(r"^/api/dags/(\d+)/restart$"), "restart_dag"),
+]
+
 _ROUTES = [
     (re.compile(r"^/api/dags$"), "dags"),
     (re.compile(r"^/api/dags/(\d+)/tasks$"), "dag_tasks"),
@@ -226,10 +231,16 @@ function renderReport(div,rep,p){
 
 async function refresh(){
  const dags=await J('/api/dags');const t=document.getElementById('dags');
- t.innerHTML='';row(t,['id','name','project','status','tasks'],true);
+ t.innerHTML='';row(t,['id','name','project','status','tasks','actions'],true);
+ const act=d=>{const span=document.createElement('span');
+  const P=(verb)=>fetch('/api/dags/'+d.id+'/'+verb,{method:'POST',
+   headers:{'X-Requested-With':'mlcomp-tpu'}}).then(()=>refresh());
+  if(d.status==='in_progress')span.appendChild(link('stop',()=>P('stop')));
+  else if(d.status!=='success')span.appendChild(link('restart',()=>P('restart')));
+  return span};
  for(const d of dags)
   row(t,[link(d.id,()=>{curDag=d.id;refresh()}),d.name,d.project,
-   [d.status,d.status],JSON.stringify(d.counts)]);
+   [d.status,d.status],JSON.stringify(d.counts),act(d)]);
  if(curDag===null&&dags.length)curDag=dags[dags.length-1].id;
  if(curDag!==null){
   document.getElementById('dagsel').textContent='(dag '+curDag+')';
@@ -293,12 +304,9 @@ class _Handler(BaseHTTPRequestHandler):
     def _json(self, obj: Any, code: int = 200) -> None:
         self._send(code, json.dumps(obj).encode(), "application/json")
 
-    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+    def _dispatch(self, routes) -> None:
         path = self.path.split("?", 1)[0]
-        if path in ("/", "/index.html"):
-            self._send(200, _DASHBOARD.encode(), "text/html; charset=utf-8")
-            return
-        for pat, name in _ROUTES:
+        for pat, name in routes:
             m = pat.match(path)
             if m:
                 store = Store(self.db_path)
@@ -310,6 +318,23 @@ class _Handler(BaseHTTPRequestHandler):
                     store.close()
                 return
         self._json({"error": "not found"}, code=404)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        if path in ("/", "/index.html"):
+            self._send(200, _DASHBOARD.encode(), "text/html; charset=utf-8")
+            return
+        self._dispatch(_ROUTES)
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        # CSRF guard: a custom header makes any cross-origin browser call a
+        # preflighted request, and this server never answers preflights —
+        # so drive-by pages can't stop/restart DAGs.  curl users add
+        # -H 'X-Requested-With: mlcomp-tpu'.
+        if not self.headers.get("X-Requested-With"):
+            self._json({"error": "missing X-Requested-With header"}, code=403)
+            return
+        self._dispatch(_POST_ROUTES)
 
     # ---- route impls -----------------------------------------------------
 
@@ -340,6 +365,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _r_report_payload(self, store: Store, report_id: str):
         payload = store.report_payload(int(report_id))
         return payload if payload is not None else {"error": "no such report"}
+
+    def _r_stop_dag(self, store: Store, dag_id: str):
+        return {"dag_id": int(dag_id), "stopped_tasks": store.stop_dag(int(dag_id))}
+
+    def _r_restart_dag(self, store: Store, dag_id: str):
+        return {"dag_id": int(dag_id), "reset_tasks": store.restart_dag(int(dag_id))}
 
     def _r_workers(self, store: Store):
         return store.workers()

@@ -121,12 +121,14 @@ class Supervisor:
         """Requeue or fail tasks stranded on workers that stopped heartbeating."""
         for name in self.store.dead_workers(self.worker_timeout_s):
             for task in self.store.tasks_on_worker(name):
-                if not self.store.requeue_task(task["id"]):
-                    self.store.finish_task(
+                if not self.store.requeue_task(task["id"], expect_worker=name):
+                    if not self.store.finish_task(
                         task["id"],
                         TaskStatus.FAILED,
                         error=f"worker {name!r} died and retries exhausted",
-                    )
+                        expect_worker=name,
+                    ):
+                        continue  # task was stopped/re-claimed meanwhile
                     self._notify(
                         "task_failed",
                         task_id=task["id"],

@@ -143,7 +143,9 @@ class Worker:
             )
         else:
             self.store.log(claim["id"], "error", err or "unknown error")
-            if not self.store.requeue_task(claim["id"]):
+            # expect_worker: if the task was stopped or reaped+re-claimed
+            # while we ran, neither requeue nor fail must touch it
+            if not self.store.requeue_task(claim["id"], expect_worker=self.name):
                 self.store.finish_task(
                     claim["id"],
                     TaskStatus.FAILED,

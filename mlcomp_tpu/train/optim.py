@@ -76,6 +76,24 @@ def lamb(lr=1e-3, weight_decay: float = 0.0, **_):
     return optax.lamb(_sched(lr), weight_decay=weight_decay)
 
 
+@OPTIMIZERS.register("lars")
+def lars(
+    lr=0.1,
+    weight_decay: float = 1e-4,
+    momentum: float = 0.9,
+    trust_coefficient: float = 0.001,
+    **_,
+):
+    """Layer-wise adaptive rate scaling — the classic large-batch ResNet
+    recipe (batch 8k+ on pods needs per-layer trust ratios to converge)."""
+    return optax.lars(
+        _sched(lr),
+        weight_decay=weight_decay,
+        momentum=momentum,
+        trust_coefficient=trust_coefficient,
+    )
+
+
 @OPTIMIZERS.register("rmsprop")
 def rmsprop(lr=1e-3, decay: float = 0.9, eps: float = 1e-8, momentum: float = 0.0, **_):
     return optax.rmsprop(_sched(lr), decay=decay, eps=eps, momentum=momentum)

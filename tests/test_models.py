@@ -123,3 +123,43 @@ def test_models_have_gradients():
     norms = [float(jnp.abs(g).max()) for g in jax.tree.leaves(grads)]
     assert all(np.isfinite(n) for n in norms)
     assert any(n > 0 for n in norms)
+
+
+def test_vit_forward_and_trains():
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "vit_tiny", "num_classes": 4, "patch": 8,
+                  "dtype": "float32"},
+        "optimizer": {"name": "lars", "lr": 0.1},
+        "loss": "cross_entropy",
+        "metrics": ["accuracy"],
+        "epochs": 1,
+        "data": {
+            "train": {"name": "synthetic_images", "n": 16, "image": 32,
+                      "num_classes": 4, "batch_size": 8}
+        },
+    }
+    tr = Trainer(cfg)
+    stats = tr.train_epoch()
+    assert np.isfinite(stats["loss"])
+
+
+def test_vit_cls_pooling():
+    import jax
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.train.state import init_model
+
+    m = create_model({"name": "vit_tiny", "num_classes": 3, "patch": 8,
+                      "pool": "cls", "dtype": "float32"})
+    x = jnp.zeros((2, 32, 32, 3))
+    params, state = init_model(m, {"x": x}, jax.random.PRNGKey(0))
+    out = m.apply({"params": params, **state}, x)
+    assert out.shape == (2, 3)
+
+
+def test_lars_optimizer_builds():
+    from mlcomp_tpu.train.optim import create_optimizer
+
+    tx = create_optimizer({"name": "lars", "lr": 0.5, "weight_decay": 1e-4})
+    assert tx is not None
