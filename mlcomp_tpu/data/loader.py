@@ -35,6 +35,10 @@ class DataLoader:
         mesh=None,
         pad_to_batch: bool = True,
     ):
+        # "_"-prefixed keys are dataset metadata (e.g. _class_names), not
+        # batchable arrays — kept aside for consumers like report builders
+        self.meta = {k: v for k, v in data.items() if k.startswith("_")}
+        data = {k: v for k, v in data.items() if not k.startswith("_")}
         n = len(next(iter(data.values())))
         for k, v in data.items():
             if len(v) != n:

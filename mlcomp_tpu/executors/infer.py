@@ -147,7 +147,10 @@ class ValidExecutor(Executor):
         kind = rc.get("kind")
         if kind not in (None, "classification", "segmentation"):
             raise ValueError(f"unknown report kind {kind!r}")
-        names = rc.get("classes")
+        # explicit classes win; else the dataset's own names (image_folder)
+        names = rc.get("classes") or trainer.loaders["valid"].meta.get(
+            "_class_names"
+        )
 
         # ONE jitted dispatch per batch: outputs + the very same eval step
         # eval_epoch runs (shared code so the formulas can never diverge);

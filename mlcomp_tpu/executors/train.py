@@ -34,6 +34,24 @@ class TrainExecutor(Executor):
         project = cfg.pop("project", "default")
         dag_name = cfg.pop("dag_name", f"dag{ctx.dag_id}")
         ckpt_dir = storage.checkpoint_dir(project, dag_name, ctx.task_name)
+        # Catalyst parity (main_metric/minimize_metric): track the best
+        # epoch by a named metric and keep its checkpoint separately
+        best_metric = cfg.pop("best_metric", None)
+        best_mode = cfg.pop("best_mode", "max")
+        if best_mode not in ("max", "min"):
+            raise ValueError(f"best_mode must be max|min, got {best_mode!r}")
+        best: Dict[str, Any] = {"value": None, "epoch": None, "step": None}
+        best_dir = str(Path(ckpt_dir) / "best")
+        # resume-safe: a restarted task must not let a worse post-restart
+        # epoch overwrite the pre-restart best checkpoint
+        prior = storage.read_meta(project, dag_name, ctx.task_name).get("best")
+        if best_metric and prior and prior.get("metric") == best_metric:
+            best.update(
+                value=prior.get("value"),
+                epoch=prior.get("epoch"),
+                step=prior.get("step"),
+            )
+        _warned_missing = [False]
 
         # trace: true → spans land next to the checkpoints
         if cfg.get("trace") and not (
@@ -62,6 +80,31 @@ class TrainExecutor(Executor):
             )
             if (epoch + 1) % int(cfg.get("ckpt_every", 1)) == 0:
                 save_checkpoint(ckpt_dir, trainer.state, step=int(trainer.state.step))
+            if best_metric and best_metric not in stats:
+                if not _warned_missing[0]:
+                    _warned_missing[0] = True
+                    ctx.log(
+                        f"best_metric {best_metric!r} not in epoch stats"
+                        f" (have: {sorted(stats)}); no best checkpoint"
+                        " will be tracked",
+                        level="warning",
+                    )
+            if best_metric and best_metric in stats:
+                v = float(stats[best_metric])
+                improved = best["value"] is None or (
+                    v > best["value"] if best_mode == "max" else v < best["value"]
+                )
+                if improved:
+                    best.update(
+                        value=v, epoch=epoch, step=int(trainer.state.step)
+                    )
+                    save_checkpoint(
+                        best_dir, trainer.state, step=int(trainer.state.step)
+                    )
+                    ctx.log(
+                        f"new best {best_metric}={v:.4f} @ epoch {epoch}"
+                        f" -> {best_dir}"
+                    )
 
         final = trainer.fit(on_epoch=on_epoch)
         if trainer.trace_path:
@@ -70,13 +113,21 @@ class TrainExecutor(Executor):
         if latest_step(ckpt_dir) != cur:  # avoid re-saving the epoch save
             save_checkpoint(ckpt_dir, trainer.state, step=cur)
         ckpt_path = str(Path(ckpt_dir) / str(cur))
-        storage.write_meta(
-            project,
-            dag_name,
-            ctx.task_name,
-            {"final": final, "params": trainer.n_params, "ckpt": ckpt_path},
-        )
-        return {"ckpt_dir": str(ckpt_dir), "final": final, "params": trainer.n_params}
+        meta: Dict[str, Any] = {
+            "final": final,
+            "params": trainer.n_params,
+            "ckpt": ckpt_path,
+        }
+        result: Dict[str, Any] = {
+            "ckpt_dir": str(ckpt_dir),
+            "final": final,
+            "params": trainer.n_params,
+        }
+        if best_metric and best["value"] is not None:
+            meta["best"] = dict(best, metric=best_metric)
+            result["best"] = dict(best, metric=best_metric, ckpt_dir=best_dir)
+        storage.write_meta(project, dag_name, ctx.task_name, meta)
+        return result
 
 
 class CatalystAlias(TrainExecutor):

@@ -1,0 +1,217 @@
+"""image_folder dataset, loader metadata, best-checkpoint tracking."""
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.data.datasets import create_dataset
+from mlcomp_tpu.data.loader import DataLoader
+
+
+@pytest.fixture()
+def image_tree(tmp_path):
+    from PIL import Image
+
+    for cls, color in [("cat", (255, 0, 0)), ("dog", (0, 255, 0))]:
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.new("RGB", (10, 8), color).save(d / f"{i}.png")
+    return str(tmp_path)
+
+
+def test_image_folder_loads_tree(image_tree):
+    ds = create_dataset({"name": "image_folder", "path": image_tree, "image": 16})
+    assert ds["x"].shape == (6, 16, 16, 3)
+    assert ds["x"].dtype == np.float32 and ds["x"].max() <= 1.0
+    assert ds["y"].tolist() == [0, 0, 0, 1, 1, 1]
+    assert ds["_class_names"] == ["cat", "dog"]
+    # red channel dominates for 'cat' images
+    assert ds["x"][0, 0, 0, 0] == pytest.approx(1.0)
+
+
+def test_image_folder_limit(image_tree):
+    ds = create_dataset(
+        {"name": "image_folder", "path": image_tree, "image": 8, "limit": 1}
+    )
+    assert len(ds["y"]) == 2
+
+
+def test_loader_keeps_meta_out_of_batches(image_tree):
+    ds = create_dataset({"name": "image_folder", "path": image_tree, "image": 8})
+    dl = DataLoader(ds, batch_size=3, shuffle=False, mesh=None)
+    assert dl.meta["_class_names"] == ["cat", "dog"]
+    batch = next(iter(dl))
+    assert set(batch) == {"x", "y"}
+
+
+def test_valid_report_uses_dataset_class_names(tmp_db, image_tree):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="v", executor="valid"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 2},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "data": {
+            "valid": {"name": "image_folder", "path": image_tree,
+                      "image": 8, "batch_size": 8}
+        },
+        "report": {"kind": "classification"},
+    }
+    ok, _, err = run_task(
+        "valid",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="v",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    payload = store.report_payload(store.reports(tid)[0]["id"])
+    assert payload["class_names"] == ["cat", "dog"]
+    store.close()
+
+
+def test_best_checkpoint_tracking(tmp_db, tmp_path):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+    from mlcomp_tpu.io.checkpoint import latest_step
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="train"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "storage_root": str(tmp_path),
+        "model": {"name": "mlp", "hidden": [16], "num_classes": 3},
+        "optimizer": {"name": "adam", "lr": 5e-2},
+        "loss": "cross_entropy",
+        "metrics": ["accuracy"],
+        "epochs": 3,
+        "best_metric": "valid/accuracy",
+        "data": {
+            "train": {"name": "synthetic_classification", "n": 64,
+                      "num_classes": 3, "dim": 8, "batch_size": 16},
+            "valid": {"name": "synthetic_classification", "n": 32,
+                      "num_classes": 3, "dim": 8, "seed": 1, "batch_size": 16},
+        },
+    }
+    ok, result, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    assert "best" in result and result["best"]["metric"] == "valid/accuracy"
+    assert result["best"]["value"] is not None
+    assert latest_step(result["best"]["ckpt_dir"]) == result["best"]["step"]
+    store.close()
+
+
+def test_best_mode_validation():
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    ok, _, err = run_task(
+        "train",
+        ExecutionContext(dag_id=1, task_id=1, task_name="t",
+                         args={"best_mode": "upwards"}, store=None),
+    )
+    assert not ok and "best_mode" in err
+
+
+def test_best_survives_resume(tmp_db, tmp_path):
+    """Restarted training must not overwrite a better pre-restart best."""
+    from mlcomp_tpu.io.storage import ModelStorage
+
+    storage = ModelStorage(str(tmp_path))
+    # simulate a pre-restart run that recorded best accuracy 0.99
+    storage.write_meta(
+        "default", "dag1", "t",
+        {"best": {"metric": "valid/accuracy", "value": 0.99, "epoch": 1,
+                  "step": 4}},
+    )
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="train"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "storage_root": str(tmp_path),
+        "dag_name": "dag1",
+        "model": {"name": "mlp", "hidden": [4], "num_classes": 3},
+        "optimizer": {"name": "sgd", "lr": 1e-4},  # barely learns
+        "loss": "cross_entropy",
+        "metrics": ["accuracy"],
+        "epochs": 1,
+        "best_metric": "valid/accuracy",
+        "data": {
+            "train": {"name": "synthetic_classification", "n": 32,
+                      "num_classes": 3, "dim": 8, "batch_size": 16},
+            "valid": {"name": "synthetic_classification", "n": 16,
+                      "num_classes": 3, "dim": 8, "seed": 1, "batch_size": 16},
+        },
+    }
+    ok, result, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    # one low-lr epoch can't beat 0.99: prior best must be preserved
+    assert result["best"]["value"] == 0.99 and result["best"]["epoch"] == 1
+    store.close()
+
+
+def test_missing_best_metric_warns(tmp_db, tmp_path):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="train"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    cfg = {
+        "storage_root": str(tmp_path),
+        "model": {"name": "mlp", "hidden": [4], "num_classes": 3},
+        "optimizer": {"name": "sgd", "lr": 1e-3},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "epochs": 2,
+        "best_metric": "accuracy",  # unprefixed: never in stats
+        "data": {
+            "train": {"name": "synthetic_classification", "n": 32,
+                      "num_classes": 3, "dim": 8, "batch_size": 16},
+        },
+    }
+    ok, result, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    msgs = [l["message"] for l in store.task_logs(tid)]
+    warnings = [m for m in msgs if "best_metric" in m and "not in epoch stats" in m]
+    assert len(warnings) == 1, msgs  # warned once, not per epoch
+    assert "best" not in result
+    store.close()
