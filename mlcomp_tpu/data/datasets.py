@@ -165,14 +165,13 @@ def image_folder(
                 im.convert("RGB").resize((image, image), Image.BILINEAR),
                 dtype=np.float32,
             )
-    ys = ys_list
     if normalize:
         x /= 255.0
     # "_"-prefixed keys are per-dataset metadata, not batchable arrays
     # (DataLoader keeps them aside; reports read class names from here)
     return {
         "x": x,
-        "y": np.asarray(ys, dtype=np.int32),
+        "y": np.asarray(ys_list, dtype=np.int32),
         "_class_names": classes,
     }
 

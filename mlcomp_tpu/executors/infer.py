@@ -160,7 +160,7 @@ class ValidExecutor(Executor):
         eval_step = make_eval_step(trainer.loss_fn, trainer.metric_fns)
 
         def fwd_stats(state, batch):
-            out = state.apply_fn(state.variables, batch["x"], train=False)
+            out = state.apply_fn(state.eval_variables, batch["x"], train=False)
             return out, eval_step(state, batch)
 
         fwd = jax.jit(fwd_stats)

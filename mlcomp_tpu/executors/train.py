@@ -44,7 +44,8 @@ class TrainExecutor(Executor):
         best_dir = str(Path(ckpt_dir) / "best")
         # resume-safe: a restarted task must not let a worse post-restart
         # epoch overwrite the pre-restart best checkpoint
-        prior = storage.read_meta(project, dag_name, ctx.task_name).get("best")
+        meta_prior = storage.read_meta(project, dag_name, ctx.task_name)
+        prior = meta_prior.get("best")
         if best_metric and prior and prior.get("metric") == best_metric:
             best.update(
                 value=prior.get("value"),
@@ -70,6 +71,20 @@ class TrainExecutor(Executor):
         if start_step is not None and cfg.get("resume", True):
             trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
             ctx.log(f"resumed from checkpoint step {start_step}")
+            # a prior run's early-stop decision stands on resume (unless the
+            # epoch budget was raised since); patience counters themselves
+            # are not persisted — only the final verdict is
+            es_prior = meta_prior.get("early_stopped")
+            if (
+                es_prior is not None
+                and cfg.get("early_stop")
+                and int(es_prior.get("epochs", -1)) == trainer.epochs
+            ):
+                ctx.log(
+                    f"early stop from prior run stands (epoch"
+                    f" {es_prior.get('epoch')}); skipping training"
+                )
+                trainer.epochs = trainer.epochs_done  # fit() runs nothing
 
         def on_epoch(epoch: int, stats: Dict[str, float]) -> None:
             for k, v in stats.items():
@@ -90,11 +105,10 @@ class TrainExecutor(Executor):
                         level="warning",
                     )
             if best_metric and best_metric in stats:
+                from mlcomp_tpu.train.loop import metric_improved
+
                 v = float(stats[best_metric])
-                improved = best["value"] is None or (
-                    v > best["value"] if best_mode == "max" else v < best["value"]
-                )
-                if improved:
+                if metric_improved(v, best["value"], best_mode):
                     best.update(
                         value=v, epoch=epoch, step=int(trainer.state.step)
                     )
@@ -107,6 +121,8 @@ class TrainExecutor(Executor):
                     )
 
         final = trainer.fit(on_epoch=on_epoch)
+        if trainer.stopped_early is not None:
+            ctx.log(f"early stop at epoch {trainer.stopped_early}")
         if trainer.trace_path:
             ctx.log(f"trace written to {trainer.trace_path}")
         cur = int(trainer.state.step)
@@ -126,6 +142,16 @@ class TrainExecutor(Executor):
         if best_metric and best["value"] is not None:
             meta["best"] = dict(best, metric=best_metric)
             result["best"] = dict(best, metric=best_metric, ckpt_dir=best_dir)
+        if trainer.stopped_early is not None:
+            meta["early_stopped"] = {
+                "epoch": trainer.stopped_early,
+                "epochs": trainer.epochs,
+            }
+            result["early_stopped"] = trainer.stopped_early
+        elif meta_prior.get("early_stopped") is not None and cfg.get(
+            "early_stop"
+        ):
+            meta["early_stopped"] = meta_prior["early_stopped"]
         storage.write_meta(project, dag_name, ctx.task_name, meta)
         return result
 

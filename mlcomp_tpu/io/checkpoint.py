@@ -47,10 +47,42 @@ def latest_step(directory: str | Path) -> Optional[int]:
 def restore_checkpoint(
     directory: str | Path, target: Any, step: Optional[int] = None
 ) -> Any:
-    """Restore into the structure of ``target`` (shapes/shardings from it)."""
+    """Restore into the structure of ``target`` (shapes/shardings from it).
+
+    EMA tolerance: a TrainState's ``ema_params`` presence depends on the
+    restoring task's own config, and downstream valid/infer tasks don't
+    know whether the train task tracked EMA.  If the on-disk tree and the
+    target disagree on ``ema_params``, the target is adapted:
+
+    - saved WITH ema, target without → restore the EMA too (eval then
+      runs on the EMA weights, which is the feature's whole point);
+    - saved WITHOUT ema, target with → restore without, then seed the
+      EMA from the restored params so tracking starts fresh.
+    """
     directory = Path(directory).absolute()
     with _mgr(directory) as mgr:
         step = step if step is not None else mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
-        return mgr.restore(step, args=ocp.args.StandardRestore(target))
+        try:
+            return mgr.restore(step, args=ocp.args.StandardRestore(target))
+        except ValueError:
+            # likely an ema_params presence mismatch — retry with the
+            # opposite interpretation (orbax's item_metadata is not
+            # reliable across versions, so probe rather than inspect)
+            if getattr(target, "ema_params", None) is not None:
+                restored = mgr.restore(
+                    step,
+                    args=ocp.args.StandardRestore(
+                        target.replace(ema_params=None)
+                    ),
+                )
+                return restored.replace(
+                    ema_params=jax.tree.map(lambda p: p, restored.params)
+                )
+            if hasattr(target, "ema_params") and hasattr(target, "params"):
+                adapted = target.replace(
+                    ema_params=jax.tree.map(lambda p: p, target.params)
+                )
+                return mgr.restore(step, args=ocp.args.StandardRestore(adapted))
+            raise

@@ -79,9 +79,20 @@ def make_train_step(
     return train_step
 
 
+def metric_improved(
+    value: float, best: Optional[float], mode: str, min_delta: float = 0.0
+) -> bool:
+    """Shared improvement predicate for early stopping and best-checkpoint
+    tracking (one definition so min/max/delta semantics can't diverge)."""
+    if best is None:
+        return True
+    return value < best - min_delta if mode == "min" else value > best + min_delta
+
+
 def make_eval_step(loss_fn, metric_fns: Dict[str, Callable]):
     def eval_step(state: TrainState, batch):
-        outputs = state.apply_fn(state.variables, batch["x"], train=False)
+        # eval_variables: EMA params when the state tracks them
+        outputs = state.apply_fn(state.eval_variables, batch["x"], train=False)
         stats = {"loss": loss_fn(outputs, batch)}
         for name, fn in metric_fns.items():
             stats[name] = fn(outputs, batch)
@@ -150,11 +161,16 @@ class Trainer:
         split0 = "train" if "train" in self.loaders else next(iter(self.loaders))
         sample_x = jnp.asarray(self._loader(split0).data["x"][:1])
 
+        ema_decay = float(cfg.get("ema", 0.0) or 0.0)
+
         def _create_state():
             params, model_state = init_model(
                 self.model, {"x": sample_x}, jax.random.PRNGKey(self.seed)
             )
-            return TrainState.create(self.model.apply, params, self.tx, model_state)
+            return TrainState.create(
+                self.model.apply, params, self.tx, model_state,
+                ema_decay=ema_decay,
+            )
 
         # fsdp/tp-aware sharded init: each device materializes only its own
         # shard (parallel/sharding.py); pure-dp meshes resolve to replicated
@@ -174,7 +190,7 @@ class Trainer:
         )
         self._eval_step = jax.jit(make_eval_step(self.loss_fn, self.metric_fns))
         self._infer_fn = jax.jit(
-            lambda state, x: state.apply_fn(state.variables, x, train=False)
+            lambda state, x: state.apply_fn(state.eval_variables, x, train=False)
         )
 
     def _loader(self, split: str) -> DataLoader:
@@ -221,7 +237,27 @@ class Trainer:
     ) -> Dict[str, float]:
         """Run up to ``epochs`` total; resume-aware: a restored state that
         already completed k epochs (by step count) runs only the remainder,
-        and epoch numbers continue from k so metric series don't overlap."""
+        and epoch numbers continue from k so metric series don't overlap.
+
+        ``early_stop`` config (Catalyst EarlyStoppingCallback parity):
+        ``{metric: valid/loss, mode: min, patience: 3, min_delta: 0}`` or
+        ``true`` for those defaults — stops when the metric hasn't
+        improved for ``patience`` consecutive epochs.  The stopping epoch
+        is recorded on ``self.stopped_early``."""
+        es = self.cfg.get("early_stop")
+        es = {} if es is True else (dict(es) if es else None)
+        if es is not None:
+            es_metric = es.get("metric", "valid/loss")
+            es_mode = es.get("mode", "min")
+            if es_mode not in ("min", "max"):
+                raise ValueError(f"early_stop.mode must be min|max, got {es_mode!r}")
+            es_patience = int(es.get("patience", 3))
+            es_delta = float(es.get("min_delta", 0.0))
+            es_best: Optional[float] = None
+            es_since = 0
+            es_warned = False
+        self.stopped_early: Optional[int] = None
+
         last: Dict[str, float] = {}
         tracer = self.tracer if self.tracer is not None else get_tracer()
         if self.tracer is not None:
@@ -245,6 +281,27 @@ class Trainer:
                 if on_epoch is not None:
                     on_epoch(epoch, stats)
                 last = stats
+                if es is not None:
+                    if es_metric not in stats:
+                        if not es_warned:
+                            es_warned = True
+                            import logging
+
+                            logging.getLogger("mlcomp_tpu.trainer").warning(
+                                "early_stop metric %r not in epoch stats "
+                                "(have: %s); early stopping is inactive",
+                                es_metric,
+                                sorted(stats),
+                            )
+                    else:
+                        v = float(stats[es_metric])
+                        if metric_improved(v, es_best, es_mode, es_delta):
+                            es_best, es_since = v, 0
+                        else:
+                            es_since += 1
+                            if es_since >= es_patience:
+                                self.stopped_early = epoch
+                                break
         finally:
             if self.tracer is not None:
                 set_tracer(None)

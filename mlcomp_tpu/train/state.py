@@ -24,9 +24,16 @@ class TrainState:
     opt_state: Any
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
     apply_fn: Callable = flax.struct.field(pytree_node=False)
+    #: exponential moving average of params (None unless ema_decay > 0);
+    #: eval/infer prefer these — the standard trick for a few tenths of
+    #: accuracy at zero extra forward cost
+    ema_params: Any = None
+    ema_decay: float = flax.struct.field(pytree_node=False, default=0.0)
 
     @classmethod
-    def create(cls, apply_fn, params, tx, model_state=None) -> "TrainState":
+    def create(
+        cls, apply_fn, params, tx, model_state=None, ema_decay: float = 0.0
+    ) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -34,23 +41,39 @@ class TrainState:
             opt_state=tx.init(params),
             tx=tx,
             apply_fn=apply_fn,
+            ema_params=jax.tree.map(jnp.copy, params) if ema_decay else None,
+            ema_decay=float(ema_decay),
         )
 
     def apply_gradients(self, grads, new_model_state=None) -> "TrainState":
         updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        new_ema = self.ema_params
+        if self.ema_params is not None and self.ema_decay:
+            d = self.ema_decay
+            new_ema = jax.tree.map(
+                lambda e, p: d * e + (1.0 - d) * p, self.ema_params, new_params
+            )
         return self.replace(
             step=self.step + 1,
-            params=optax.apply_updates(self.params, updates),
+            params=new_params,
             model_state=(
                 new_model_state if new_model_state is not None else self.model_state
             ),
             opt_state=new_opt,
+            ema_params=new_ema,
         )
 
     @property
     def variables(self) -> Dict[str, Any]:
-        """Full variable dict for model.apply."""
+        """Full variable dict for model.apply (raw training params)."""
         return {"params": self.params, **self.model_state}
+
+    @property
+    def eval_variables(self) -> Dict[str, Any]:
+        """Variables for eval/infer: EMA params when tracked, else raw."""
+        params = self.ema_params if self.ema_params is not None else self.params
+        return {"params": params, **self.model_state}
 
 
 def init_model(model, sample_batch, rng: Optional[jax.Array] = None):

@@ -215,3 +215,94 @@ def test_missing_best_metric_warns(tmp_db, tmp_path):
     assert len(warnings) == 1, msgs  # warned once, not per epoch
     assert "best" not in result
     store.close()
+
+
+def _es_cfg(tmp_path, epochs=15):
+    return {
+        "storage_root": str(tmp_path),
+        "dag_name": "dag1",
+        "model": {"name": "mlp", "hidden": [8], "num_classes": 3},
+        "optimizer": {"name": "sgd", "lr": 0.0},  # instant plateau
+        "loss": "cross_entropy",
+        "metrics": [],
+        "epochs": epochs,
+        "early_stop": {"metric": "valid/loss", "patience": 2},
+        "data": {
+            "train": {"name": "synthetic_classification", "n": 32,
+                      "num_classes": 3, "dim": 8, "batch_size": 16},
+            "valid": {"name": "synthetic_classification", "n": 16,
+                      "num_classes": 3, "dim": 8, "seed": 1, "batch_size": 16},
+        },
+    }
+
+
+def test_early_stop_decision_survives_restart(tmp_db, tmp_path):
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="train"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    ok, r1, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=_es_cfg(tmp_path), store=store),
+    )
+    assert ok, err
+    assert r1["early_stopped"] == 2  # epoch 0 best + 2 plateau epochs
+    steps_after_first = 3 * 2  # 3 epochs x 2 steps/epoch
+
+    # re-run the same task (restart): the verdict must stand, no new epochs
+    ok, r2, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=_es_cfg(tmp_path), store=store),
+    )
+    assert ok, err
+    msgs = [l["message"] for l in store.task_logs(tid)]
+    assert any("early stop from prior run stands" in m for m in msgs), msgs
+
+    # raising the epoch budget re-enables training
+    ok, r3, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=_es_cfg(tmp_path, epochs=30), store=store),
+    )
+    assert ok, err
+    store.close()
+
+
+def test_ema_checkpoint_cross_restore(tmp_path):
+    """EMA/non-EMA checkpoint-target mismatches restore adaptively."""
+    import jax
+    import jax.numpy as jnp
+    from mlcomp_tpu.io.checkpoint import restore_checkpoint, save_checkpoint
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.train.optim import create_optimizer
+    from mlcomp_tpu.train.state import TrainState, init_model
+
+    m = create_model({"name": "mlp", "hidden": [8], "num_classes": 3})
+    p, ms = init_model(m, {"x": jnp.zeros((1, 4))}, jax.random.PRNGKey(0))
+    tx = create_optimizer({"name": "sgd", "lr": 0.1})
+
+    # saved WITH ema -> restored into a non-ema target: EMA adopted
+    with_ema = TrainState.create(m.apply, p, tx, ms, ema_decay=0.9)
+    save_checkpoint(str(tmp_path / "a"), with_ema, step=1)
+    plain_target = TrainState.create(m.apply, p, tx, ms)
+    restored = restore_checkpoint(str(tmp_path / "a"), plain_target)
+    assert restored.ema_params is not None
+
+    # saved WITHOUT ema -> restored into an ema target: seeded from params
+    plain = TrainState.create(m.apply, p, tx, ms)
+    save_checkpoint(str(tmp_path / "b"), plain, step=1)
+    ema_target = TrainState.create(m.apply, p, tx, ms, ema_decay=0.9)
+    restored = restore_checkpoint(str(tmp_path / "b"), ema_target)
+    assert restored.ema_params is not None
+    a = jax.tree.leaves(restored.ema_params)[0]
+    b = jax.tree.leaves(restored.params)[0]
+    assert np.allclose(np.asarray(a), np.asarray(b))

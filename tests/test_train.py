@@ -193,3 +193,45 @@ def test_dice_and_smoothed_ce_ignore_void_labels():
     full = sce(lg[:2], {"y": jnp.asarray([0, 1])})
     with_void = sce(lg, {"y": jnp.asarray([0, 1, 255])})
     assert float(full) == pytest.approx(float(with_void), rel=1e-6)
+
+
+def test_early_stopping_halts_on_plateau():
+    cfg = mlp_cfg(epochs=20)
+    cfg["optimizer"] = {"name": "sgd", "lr": 0.0}  # lr 0: instant plateau
+    cfg["early_stop"] = {"metric": "valid/loss", "patience": 2}
+    tr = Trainer(cfg)
+    seen = []
+    tr.fit(on_epoch=lambda e, s: seen.append(e))
+    assert tr.stopped_early is not None
+    # first epoch sets best; 2 more non-improving epochs trip patience=2
+    assert len(seen) == 3, seen
+
+
+def test_early_stopping_mode_validation():
+    cfg = mlp_cfg()
+    cfg["early_stop"] = {"mode": "sideways"}
+    with pytest.raises(ValueError, match="early_stop.mode"):
+        Trainer(cfg).fit()
+
+
+def test_ema_tracked_and_used_for_eval():
+    import jax.numpy as jnp
+
+    cfg = mlp_cfg(epochs=1)
+    cfg["ema"] = 0.9
+    tr = Trainer(cfg)
+    tr.train_epoch()
+    assert tr.state.ema_params is not None
+    # ema must lag the raw params after aggressive updates
+    raw = jax.tree.leaves(tr.state.params)[0]
+    ema = jax.tree.leaves(tr.state.ema_params)[0]
+    assert not np.allclose(np.asarray(raw), np.asarray(ema))
+    # eval_variables serves the ema copy
+    assert np.allclose(
+        np.asarray(jax.tree.leaves(tr.state.eval_variables["params"])[0]),
+        np.asarray(ema),
+    )
+    # without ema config, eval_variables == variables
+    tr2 = Trainer(mlp_cfg(epochs=1))
+    assert tr2.state.ema_params is None
+    assert tr2.state.eval_variables["params"] is tr2.state.params
