@@ -67,19 +67,23 @@ class TrainExecutor(Executor):
         )
 
         # resume if a checkpoint exists (restart-safe training tasks)
+        verdict_stands: Optional[Dict[str, Any]] = None
         start_step = latest_step(ckpt_dir)
         if start_step is not None and cfg.get("resume", True):
             trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
             ctx.log(f"resumed from checkpoint step {start_step}")
-            # a prior run's early-stop decision stands on resume (unless the
-            # epoch budget was raised since); patience counters themselves
-            # are not persisted — only the final verdict is
+            # a prior run's early-stop decision stands on resume — but only
+            # while neither the epoch budget nor the early_stop criteria
+            # changed (a user relaxing patience/metric expects training to
+            # continue); patience counters themselves are not persisted
             es_prior = meta_prior.get("early_stopped")
             if (
                 es_prior is not None
                 and cfg.get("early_stop")
                 and int(es_prior.get("epochs", -1)) == trainer.epochs
+                and es_prior.get("config") == cfg.get("early_stop")
             ):
+                verdict_stands = es_prior
                 ctx.log(
                     f"early stop from prior run stands (epoch"
                     f" {es_prior.get('epoch')}); skipping training"
@@ -146,12 +150,17 @@ class TrainExecutor(Executor):
             meta["early_stopped"] = {
                 "epoch": trainer.stopped_early,
                 "epochs": trainer.epochs,
+                "config": cfg.get("early_stop"),
             }
             result["early_stopped"] = trainer.stopped_early
-        elif meta_prior.get("early_stopped") is not None and cfg.get(
-            "early_stop"
-        ):
-            meta["early_stopped"] = meta_prior["early_stopped"]
+        elif verdict_stands is not None:
+            meta["early_stopped"] = verdict_stands
+            result["early_stopped"] = verdict_stands.get("epoch")
+        # a skipped run (zero fit epochs) must not clobber the prior final
+        if not final and meta_prior.get("final"):
+            final = meta_prior["final"]
+            meta["final"] = final
+            result["final"] = final
         storage.write_meta(project, dag_name, ctx.task_name, meta)
         return result
 

@@ -66,23 +66,37 @@ def restore_checkpoint(
             raise FileNotFoundError(f"no checkpoints under {directory}")
         try:
             return mgr.restore(step, args=ocp.args.StandardRestore(target))
-        except ValueError:
-            # likely an ema_params presence mismatch — retry with the
+        except ValueError as orig:
+            # possibly an ema_params presence mismatch — retry with the
             # opposite interpretation (orbax's item_metadata is not
-            # reliable across versions, so probe rather than inspect)
-            if getattr(target, "ema_params", None) is not None:
-                restored = mgr.restore(
-                    step,
-                    args=ocp.args.StandardRestore(
-                        target.replace(ema_params=None)
-                    ),
-                )
-                return restored.replace(
-                    ema_params=jax.tree.map(lambda p: p, restored.params)
-                )
-            if hasattr(target, "ema_params") and hasattr(target, "params"):
-                adapted = target.replace(
-                    ema_params=jax.tree.map(lambda p: p, target.params)
-                )
-                return mgr.restore(step, args=ocp.args.StandardRestore(adapted))
-            raise
+            # reliable across versions, so probe rather than inspect);
+            # if the retry fails too, the mismatch was something else:
+            # surface the ORIGINAL error, not the retry's
+            try:
+                if getattr(target, "ema_params", None) is not None:
+                    # saved without ema, target tracks it: seed from params
+                    restored = mgr.restore(
+                        step,
+                        args=ocp.args.StandardRestore(
+                            target.replace(ema_params=None)
+                        ),
+                    )
+                    return restored.replace(
+                        ema_params=jax.tree.map(lambda p: p, restored.params)
+                    )
+                if hasattr(target, "ema_params") and hasattr(target, "params"):
+                    # saved WITH ema, target doesn't track it: the EMA
+                    # weights BECOME the params (they're the better weights
+                    # and nothing would keep updating a dangling EMA copy)
+                    adapted = target.replace(
+                        ema_params=jax.tree.map(lambda p: p, target.params)
+                    )
+                    restored = mgr.restore(
+                        step, args=ocp.args.StandardRestore(adapted)
+                    )
+                    return restored.replace(
+                        params=restored.ema_params, ema_params=None
+                    )
+            except ValueError:
+                pass
+            raise orig

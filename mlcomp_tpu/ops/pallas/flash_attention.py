@@ -101,7 +101,13 @@ def _fwd_kernel(
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(l_safe[:, 0])).astype(jnp.float32)
+        # lse is stored broadcast over a 128-lane minor dim: TPU lowering
+        # requires the last two block dims tileable to (8, 128), which a
+        # (1, 1, block_q) spec can't satisfy (same layout as the official
+        # jax.experimental TPU flash kernel's l/m outputs)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(l_safe), lse_ref[0, 0].shape
+        ).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
@@ -125,11 +131,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s_q, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -166,9 +172,9 @@ def _dq_kernel(
         s = _dot(q, k, trans_b=True) * scale
         if causal:
             s = _causal_mask(s, i, j, block_q, block_kv)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])                    # (BQ, BKV)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])                      # (BQ, BKV)
         dp = _dot(do_ref[0, 0], v_ref[0, 0], trans_b=True)         # (BQ, BKV)
-        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dq_acc[:] += _dot(ds.astype(k.dtype), k)
 
     @pl.when(j == nk - 1)
@@ -199,11 +205,11 @@ def _dkv_kernel(
         s = _dot(q, k, trans_b=True) * scale                       # (BQ, BKV)
         if causal:
             s = _causal_mask(s, i, j, block_q, block_kv)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
         pt = p.astype(do.dtype).T
         dv_acc[:] += _dot(pt, do)                                  # (BKV, D)
         dp = _dot(do, v_ref[0, 0], trans_b=True)                   # (BQ, BKV)
-        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dk_acc[:] += _dot(ds.astype(q.dtype).T, q)                 # (BKV, D)
 
     @pl.when(t == nt - 1)
@@ -220,8 +226,10 @@ def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
     nq, nk = s_q // block_q, s_k // block_kv
     do = g.astype(q.dtype)
 
-    # delta_i = sum_d dO_i * O_i — tiny elementwise reduce; XLA fuses it
+    # delta_i = sum_d dO_i * O_i — tiny elementwise reduce; XLA fuses it.
+    # Broadcast over a 128-lane minor dim like lse (TPU block tiling).
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
@@ -235,8 +243,8 @@ def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
             pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
             pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -254,9 +262,6 @@ def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
     def qh(b, hkv, j, t):
         return (b, hkv * rep + t // nq, t % nq, 0)
 
-    def qh2(b, hkv, j, t):
-        return (b, hkv * rep + t // nq, t % nq)
-
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, h_kv, nk, rep * nq),
@@ -265,8 +270,8 @@ def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
             pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
             pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
             pl.BlockSpec((1, 1, block_q, d), qh),
-            pl.BlockSpec((1, 1, block_q), qh2),
-            pl.BlockSpec((1, 1, block_q), qh2),
+            pl.BlockSpec((1, 1, block_q, LANES), qh),
+            pl.BlockSpec((1, 1, block_q, LANES), qh),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),

@@ -290,12 +290,22 @@ def test_ema_checkpoint_cross_restore(tmp_path):
     p, ms = init_model(m, {"x": jnp.zeros((1, 4))}, jax.random.PRNGKey(0))
     tx = create_optimizer({"name": "sgd", "lr": 0.1})
 
-    # saved WITH ema -> restored into a non-ema target: EMA adopted
+    # saved WITH ema -> restored into a non-ema target: the EMA weights
+    # BECOME the params (nothing would keep a dangling EMA copy updated)
     with_ema = TrainState.create(m.apply, p, tx, ms, ema_decay=0.9)
+    # make ema distinguishable from raw params
+    with_ema = with_ema.replace(
+        ema_params=jax.tree.map(lambda x: x + 1.0, with_ema.params)
+    )
     save_checkpoint(str(tmp_path / "a"), with_ema, step=1)
     plain_target = TrainState.create(m.apply, p, tx, ms)
     restored = restore_checkpoint(str(tmp_path / "a"), plain_target)
-    assert restored.ema_params is not None
+    assert restored.ema_params is None
+    got = jax.tree.leaves(restored.params)[0]
+    want = jax.tree.leaves(with_ema.ema_params)[0]
+    assert np.allclose(np.asarray(got), np.asarray(want))
+    # eval on this state now runs on the (restored) EMA weights
+    assert restored.eval_variables["params"] is restored.params
 
     # saved WITHOUT ema -> restored into an ema target: seeded from params
     plain = TrainState.create(m.apply, p, tx, ms)
@@ -306,3 +316,50 @@ def test_ema_checkpoint_cross_restore(tmp_path):
     a = jax.tree.leaves(restored.ema_params)[0]
     b = jax.tree.leaves(restored.params)[0]
     assert np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_relaxed_early_stop_config_reenables_training(tmp_db, tmp_path):
+    """Changing the early_stop criteria invalidates the prior verdict."""
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.executors import load_all
+    from mlcomp_tpu.executors.base import ExecutionContext, run_task
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = store.submit_dag(
+        DagSpec(name="d", project="p", tasks=(TaskSpec(name="t", executor="train"),))
+    )
+    tid = store.task_rows(dag_id)[0]["id"]
+    ok, r1, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=_es_cfg(tmp_path), store=store),
+    )
+    assert ok and r1["early_stopped"] == 2, err
+    assert r1["final"], "final metrics recorded"
+
+    # restart with same config: verdict stands AND prior final preserved
+    ok, r2, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=_es_cfg(tmp_path), store=store),
+    )
+    assert ok, err
+    assert r2["early_stopped"] == 2
+    assert r2["final"] == r1["final"], "skip must not clobber final metrics"
+
+    # raise patience: training re-enabled (plateau re-trips later)
+    cfg = _es_cfg(tmp_path)
+    cfg["early_stop"] = {"metric": "valid/loss", "patience": 5}
+    ok, r3, err = run_task(
+        "train",
+        ExecutionContext(dag_id=dag_id, task_id=tid, task_name="t",
+                         args=cfg, store=store),
+    )
+    assert ok, err
+    msgs = [l["message"] for l in store.task_logs(tid)]
+    # third run must NOT log the verdict-stands skip for the new config
+    stands = [m for m in msgs if "stands" in m]
+    assert len(stands) == 1, msgs  # only the second run skipped
+    store.close()
