@@ -489,6 +489,30 @@ class Store:
         ).fetchall()
         return [(r["step"], r["value"]) for r in rows]
 
+    def dag_metric_names(self, dag_id: int) -> List[str]:
+        rows = self._conn.execute(
+            "SELECT DISTINCT m.name FROM metrics m JOIN tasks t"
+            " ON m.task_id = t.id WHERE t.dag_id=?"
+            " AND m.value IS NOT NULL ORDER BY m.name",
+            (dag_id,),
+        ).fetchall()
+        return [r["name"] for r in rows]
+
+    def dag_metric_series(self, dag_id: int, name: str) -> Dict[str, List]:
+        """One metric across every task of a DAG — the grid-search
+        comparison view's data: {task_name: [[step, value], ...]}."""
+        rows = self._conn.execute(
+            "SELECT t.name AS task, m.step, m.value FROM metrics m"
+            " JOIN tasks t ON m.task_id = t.id"
+            " WHERE t.dag_id=? AND m.name=? AND m.value IS NOT NULL"
+            " ORDER BY t.id, m.step",
+            (dag_id, name),
+        ).fetchall()
+        out: Dict[str, List] = {}
+        for r in rows:
+            out.setdefault(r["task"], []).append([r["step"], r["value"]])
+        return out
+
     def metric_names(self, task_id: int) -> List[str]:
         rows = self._conn.execute(
             "SELECT DISTINCT name FROM metrics WHERE task_id=? ORDER BY name",

@@ -33,6 +33,8 @@ _POST_ROUTES = [
 _ROUTES = [
     (re.compile(r"^/api/dags$"), "dags"),
     (re.compile(r"^/api/dags/(\d+)/tasks$"), "dag_tasks"),
+    (re.compile(r"^/api/dags/(\d+)/metrics$"), "dag_metric_names"),
+    (re.compile(r"^/api/dags/(\d+)/metrics/([\w./-]+)$"), "dag_metric_series"),
     (re.compile(r"^/api/tasks/(\d+)/logs$"), "task_logs"),
     (re.compile(r"^/api/tasks/(\d+)/metrics$"), "metric_names"),
     (re.compile(r"^/api/tasks/(\d+)/metrics/([\w./-]+)$"), "metric_series"),
@@ -79,6 +81,8 @@ pre{background:var(--panel);border:1px solid var(--border);color:var(--text2);
 <h1>mlcomp-tpu report</h1>
 <h2>DAGs</h2><table id="dags"></table>
 <h2>Graph <span id="dagsel"></span></h2><svg id="graph" width="100%" height="0"></svg>
+<h2>Compare <select id="cmpsel"></select></h2>
+<div id="compare" class="charts"></div>
 <h2>Tasks</h2><table id="tasks"></table>
 <h2>Workers</h2><table id="workers"></table>
 <h2>Task detail <span id="tasksel"></span></h2>
@@ -140,16 +144,8 @@ function lineChart(name,series,xlabel='step'){
  const box=document.createElement('div');box.className='chart';
  const h=document.createElement('h3');h.textContent=name;box.appendChild(h);
  const svg=SVG('svg',{width:W,height:H});box.appendChild(svg);
- const xs=series.map(p=>p[0]),ys=series.map(p=>p[1]);
- let x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
- if(x0===x1)x1=x0+1; if(y0===y1){y0-=1;y1+=1}
- const X=v=>PL+(v-x0)/(x1-x0)*(W-PL-PR), Y=v=>PT+(1-(v-y0)/(y1-y0))*(H-PT-PB);
- const fmt=v=>Math.abs(v)>=100?v.toFixed(0):Math.abs(v)>=1?v.toFixed(2):v.toPrecision(3);
- for(let i=0;i<3;i++){const yv=y0+(y1-y0)*i/2,yy=Y(yv);
-  const gl=SVG('line',{x1:PL,x2:W-PR,y1:yy,y2:yy});
-  gl.setAttribute('stroke','var(--grid)');svg.appendChild(gl);
-  const lb=SVG('text',{x:PL-4,y:yy+3,'text-anchor':'end','font-size':9});
-  lb.setAttribute('fill','var(--text2)');lb.textContent=fmt(yv);svg.appendChild(lb);}
+ const {X,Y,x1}=axes(svg,series.map(p=>p[0]),series.map(p=>p[1]),
+  W,H,PL,PR,PT,PB);
  const xl=SVG('text',{x:W-PR,y:H-5,'text-anchor':'end','font-size':9});
  xl.setAttribute('fill','var(--text2)');xl.textContent=xlabel+' '+fmt(x1);svg.appendChild(xl);
  const path=SVG('path',{fill:'none','stroke-width':2,
@@ -178,6 +174,69 @@ function lineChart(name,series,xlabel='step'){
  svg.onmouseleave=()=>{cross.setAttribute('visibility','hidden');
   dot.setAttribute('visibility','hidden');tip.style.display='none'};
  return box}
+
+// categorical series color: golden-angle hue rotation, theme-stable
+const seriesColor=i=>'hsl('+((i*137.5+210)%360)+' 62% 46%)';
+const fmt=v=>Math.abs(v)>=100?v.toFixed(0):Math.abs(v)>=1?v.toFixed(2):v.toPrecision(3);
+
+// shared chart scaffolding: scales from data extent + gridlines/labels
+function axes(svg,xs,ys,W,H,PL,PR,PT,PB){
+ let x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+ if(x0===x1)x1=x0+1; if(y0===y1){y0-=1;y1+=1}
+ const X=v=>PL+(v-x0)/(x1-x0)*(W-PL-PR), Y=v=>PT+(1-(v-y0)/(y1-y0))*(H-PT-PB);
+ for(let i=0;i<3;i++){const yv=y0+(y1-y0)*i/2,yy=Y(yv);
+  const gl=SVG('line',{x1:PL,x2:W-PR,y1:yy,y2:yy});
+  gl.setAttribute('stroke','var(--grid)');svg.appendChild(gl);
+  const lb=SVG('text',{x:PL-4,y:yy+3,'text-anchor':'end','font-size':9});
+  lb.setAttribute('fill','var(--text2)');lb.textContent=fmt(yv);svg.appendChild(lb);}
+ return {X,Y,x1}}
+
+// multi-series overlay: one metric across a DAG's tasks (grid compare)
+function multiChart(name,byTask){
+ const W=520,H=200,PL=48,PR=10,PT=8,PB=18;
+ const entries=Object.entries(byTask).filter(([,s])=>s.length);
+ if(!entries.length)return document.createTextNode('');
+ const box=document.createElement('div');box.className='chart';
+ const h=document.createElement('h3');h.textContent=name;box.appendChild(h);
+ const svg=SVG('svg',{width:W,height:H});box.appendChild(svg);
+ const {X,Y}=axes(svg,entries.flatMap(([,s])=>s.map(p=>p[0])),
+  entries.flatMap(([,s])=>s.map(p=>p[1])),W,H,PL,PR,PT,PB);
+ entries.forEach(([task,s],i)=>{
+  const path=SVG('path',{fill:'none','stroke-width':1.8,
+   d:s.map((p,k)=>(k?'L':'M')+X(p[0]).toFixed(1)+' '+Y(p[1]).toFixed(1)).join('')});
+  path.setAttribute('stroke',seriesColor(i));
+  path.appendChild(Object.assign(SVG('title',{}),
+   {textContent:task+' (last '+fmt(s[s.length-1][1])+')'}));
+  svg.appendChild(path);});
+ const leg=document.createElement('div');
+ leg.style.cssText='display:flex;flex-wrap:wrap;gap:.3rem .8rem;font-size:.72rem';
+ entries.forEach(([task,s],i)=>{const it=document.createElement('span');
+  it.className='chip';it.style.color=seriesColor(i);
+  it.textContent=task+' · '+fmt(s[s.length-1][1]);leg.appendChild(it);});
+ box.appendChild(leg);
+ return box}
+
+let cmpBusy=false;
+async function refreshCompare(){
+ const sel=document.getElementById('cmpsel');
+ const div=document.getElementById('compare');
+ if(curDag===null){div.innerHTML='';sel.innerHTML='';return}
+ // don't collapse an open dropdown or interleave with an in-flight build
+ if(cmpBusy||document.activeElement===sel)return;
+ cmpBusy=true;
+ try{
+  const names=await J('/api/dags/'+curDag+'/metrics');
+  const keep=sel.value;
+  sel.innerHTML='';
+  for(const n of names){const o=document.createElement('option');
+   o.value=o.textContent=n;sel.appendChild(o);}
+  if(names.includes(keep))sel.value=keep;
+  sel.onchange=()=>{sel.blur();refreshCompare()};
+  div.innerHTML='';
+  if(sel.value){
+   const byTask=await J('/api/dags/'+curDag+'/metrics/'+sel.value);
+   if(Object.keys(byTask).length)div.appendChild(multiChart(sel.value,byTask));}
+ }finally{cmpBusy=false}}
 
 // confusion matrix heatmap: cell opacity ~ row-normalized count
 function confusionTable(names,cm){
@@ -246,6 +305,7 @@ async function refresh(){
   document.getElementById('dagsel').textContent='(dag '+curDag+')';
   const tasks=await J('/api/dags/'+curDag+'/tasks');
   drawGraph(tasks);
+  refreshCompare();
   const tt=document.getElementById('tasks');tt.innerHTML='';
   row(tt,['id','name','executor','stage','status','worker','error'],true);
   for(const x of tasks)
@@ -349,6 +409,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _r_dag_tasks(self, store: Store, dag_id: str):
         return store.task_rows(int(dag_id))
+
+    def _r_dag_metric_names(self, store: Store, dag_id: str):
+        return store.dag_metric_names(int(dag_id))
+
+    def _r_dag_metric_series(self, store: Store, dag_id: str, name: str):
+        return store.dag_metric_series(int(dag_id), name)
 
     def _r_task_logs(self, store: Store, task_id: str):
         return store.task_logs(int(task_id))

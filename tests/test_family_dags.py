@@ -141,3 +141,36 @@ def test_grid_search_dag_fans_out(tmp_db, tmp_path):
     )
     assert all(s == TaskStatus.SUCCESS for s in statuses.values()), statuses
     assert len(statuses) == 3
+
+
+def test_longcontext_family_trains(tmp_path):
+    """Tiny-shape version of configs/longcontext_lm.yml: ring attention
+    over sp with the same config surface."""
+    args = {
+        "storage_root": str(tmp_path),
+        "model": {
+            "name": "transformer_lm",
+            "vocab_size": 128,
+            "hidden": 32,
+            "layers": 2,
+            "heads": 4,
+            "dtype": "float32",
+            "seq_parallel": "ring",
+        },
+        "optimizer": {"name": "adamw", "lr": 1e-3},
+        "loss": "lm_cross_entropy",
+        "metrics": [],
+        "epochs": 1,
+        "mesh": {"dp": 2, "sp": 4},
+        "data": {
+            "train": {
+                "name": "synthetic_tokens",
+                "n": 8,
+                "seq_len": 32,
+                "vocab_size": 128,
+                "batch_size": 4,
+            }
+        },
+    }
+    result = _run_train(args)
+    assert result is not None

@@ -88,3 +88,25 @@ def test_dashboard_ships_charts_and_graph(served):
     html = body.decode()
     for needle in ("lineChart", "drawGraph", "prefers-color-scheme"):
         assert needle in html, needle
+
+
+def test_dag_level_metric_comparison(served):
+    """One metric across all tasks of a DAG — the grid-compare endpoint."""
+    store, dag_id, tid, port = served
+    rows = store.task_rows(dag_id)
+    tid_b = rows[1]["id"]
+    store.metric(tid_b, "train/loss", 0.8, step=0)
+    store.metric(tid_b, "train/loss", 0.4, step=1)
+
+    _, body = _get(port, f"/api/dags/{dag_id}/metrics")
+    assert json.loads(body) == ["train/loss"]
+
+    _, body = _get(port, f"/api/dags/{dag_id}/metrics/train/loss")
+    by_task = json.loads(body)
+    assert by_task["a"] == [[0, 0.5], [1, 0.25]]
+    assert by_task["b"] == [[0, 0.8], [1, 0.4]]
+
+    _, body = _get(port, "/")
+    html = body.decode()
+    for needle in ("multiChart", "refreshCompare", "cmpsel", "seriesColor"):
+        assert needle in html, needle
