@@ -13,6 +13,16 @@ Differentiable end-to-end (scan + ppermute + dynamic slices), so the
 backward pass is the mirrored drain schedule for free. ``remat=True``
 wraps the stage body in ``jax.checkpoint`` so the scan stores per-stage
 inputs instead of every intermediate — the standard memory/FLOPs trade.
+
+**Interleaved (circular) schedule**: when the stacked params carry
+``V = v * n_stages`` slices, each device holds ``v`` *virtual* stages
+assigned round-robin (device ``d`` owns virtual stages ``d, S+d, 2S+d,
+…``) and every microbatch laps the ring ``v`` times.  The fill bubble is
+``S-1`` ticks of a *virtual* stage — ``v``× smaller than GPipe's at equal
+total depth (Megatron-LM's interleaved schedule, recast as SPMD
+collectives).  Microbatches are injected in groups of ``S``; choose
+``n_microbatches`` a multiple of the stage count for a bubble-free steady
+state.
 """
 
 from __future__ import annotations
@@ -31,41 +41,71 @@ def _pipeline_local(
     microbatches: jax.Array,
     axis_name: str,
     remat: bool,
+    n_virtual: int,
 ):
     """Per-device body (inside shard_map).
 
-    stage_params: this stage's slice, leading axis of size 1 (from P(pp)).
+    stage_params: this device's slices, leading axis ``n_virtual`` (lap
+    order: virtual stages ``d, S+d, …`` for device ``d`` — pipeline_apply
+    permutes the global stack so P(pp) sharding lands them here).
     microbatches: (M, mbs, ...), replicated; only stage 0 reads it.
     Returns this device's output buffer (M, mbs, ...) — meaningful on the
     last stage, which out_specs exposes as the stacked [-1] entry.
+
+    Schedule arithmetic: microbatch ``m`` (group ``g = m // S``, position
+    ``p = m % S``) enters stage 0 at tick ``g*v*S + p`` and occupies device
+    ``d`` on lap ``k`` (virtual stage ``k*S + d``) at tick
+    ``t = g*v*S + k*S + p + d``.  Inverting for the device: with
+    ``rel = t - d``, ``g = rel // (v*S)``, ``k = (rel % (v*S)) // S``,
+    ``p = rel % S``.  ``v = 1`` degenerates to plain GPipe
+    (``m = t - d``, ingest every tick, bank on the last device).
     """
     n_stages = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
-    params = jax.tree.map(lambda x: x[0], stage_params)
     n_micro = microbatches.shape[0]
-    total = n_micro + n_stages - 1
+    lap_len = n_virtual * n_stages
+    # last microbatch M-1 sits in group (M-1)//S at position (M-1)%S and is
+    # banked by the last device at the tick below; +1 ticks total.
+    total = (
+        ((n_micro - 1) // n_stages) * lap_len
+        + (n_virtual - 1) * n_stages
+        + ((n_micro - 1) % n_stages)
+        + n_stages
+    )
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def tick(carry, t):
         cur, outputs = carry
-        # stage 0 ingests microbatch t (clamped; beyond M it's bubble junk
-        # that never reaches the output window)
-        mb = microbatches[jnp.minimum(t, n_micro - 1)]
-        cur = jnp.where(stage == 0, mb, cur)
+        rel = t - stage
+        g = rel // lap_len
+        k = (rel % lap_len) // n_stages
+        m = g * n_stages + rel % n_stages
+        # stage 0 ingests microbatch m when starting lap 0 (clamped; out-of
+        # -range m is bubble junk that is never banked)
+        mb = microbatches[jnp.clip(m, 0, n_micro - 1)]
+        cur = jnp.where((stage == 0) & (k == 0), mb, cur)
+        if n_virtual == 1:
+            # static slice, hoistable by XLA; avoids a per-tick gather
+            params = jax.tree.map(lambda x: x[0], stage_params)
+        else:
+            params = jax.tree.map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, k, 0, keepdims=False),
+                stage_params,
+            )
         out = fn(params, cur)
-        # drain: the last stage banks its result for microbatch t-(S-1)
-        slot = t - (n_stages - 1)
+        # drain: the last device banks its lap-(v-1) result for microbatch m
         outputs = jax.lax.cond(
-            slot >= 0,
+            (k == n_virtual - 1) & (m >= 0) & (m < n_micro),
             lambda o: jax.lax.dynamic_update_index_in_dim(
-                o, out, jnp.maximum(slot, 0), axis=0
+                o, out, jnp.clip(m, 0, n_micro - 1), axis=0
             ),
             lambda o: o,
             outputs,
         )
-        # hop to the next stage (ring permute; the wraparound entry into
-        # stage 0 is overwritten by the next microbatch ingest)
+        # hop to the next stage (ring permute; the wraparound into stage 0
+        # advances the microbatch to its next lap, or is overwritten by a
+        # fresh ingest when the lap count is spent)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         cur = jax.lax.ppermute(out, axis_name, perm)
         return (cur, outputs), None
@@ -88,13 +128,18 @@ def pipeline_apply(
     axis_name: str = "pp",
     remat: bool = True,
 ) -> jax.Array:
-    """Run ``x`` through ``n_stages`` pipelined stages.
+    """Run ``x`` through ``V`` pipelined virtual stages on ``n_stages`` devices.
 
     - ``stage_fn(params_slice, h) -> h``: one stage; activations keep one
       shape/dtype across stages (homogeneous trunk, e.g. decoder layers).
-    - ``stacked_params``: pytree whose leaves have a leading axis equal to
-      the ``pp`` mesh-axis size (one slice per stage).
+    - ``stacked_params``: pytree whose leaves have a leading axis ``V``, a
+      multiple of the ``pp`` mesh-axis size (one slice per virtual stage,
+      network order).  ``V == n_stages`` is plain GPipe; ``V = v*n_stages``
+      runs the interleaved circular schedule with ``v`` laps and a ``v``×
+      smaller fill bubble.
     - ``x``: (B, ...) global batch; B must divide into ``n_microbatches``.
+      With ``v > 1`` pick ``n_microbatches`` a multiple of ``n_stages``
+      (other values stay correct but waste injection slots on bubble junk).
 
     Returns (B, ...) outputs after the last stage.
     """
@@ -102,6 +147,24 @@ def pipeline_apply(
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
+    if len(leading) != 1:
+        raise ValueError(f"stacked_params leading axes disagree: {sorted(leading)}")
+    (n_total,) = leading
+    if n_total % n_stages:
+        raise ValueError(
+            f"{n_total} virtual stages not a multiple of {n_stages} pipeline devices"
+        )
+    n_virtual = n_total // n_stages
+    if n_virtual > 1:
+        # round-robin virtual-stage assignment: device d owns k*S + d, so
+        # reorder the stack to [d*v + k] -> k*S + d before P(pp) sharding
+        perm = jnp.asarray(
+            [k * n_stages + d for d in range(n_stages) for k in range(n_virtual)]
+        )
+        stacked_params = jax.tree.map(
+            lambda leaf: jnp.take(leaf, perm, axis=0), stacked_params
+        )
     mb = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
 
     run = jax.shard_map(
@@ -110,6 +173,7 @@ def pipeline_apply(
             stage_fn,
             axis_name=axis_name,
             remat=remat,
+            n_virtual=n_virtual,
         ),
         mesh=mesh,
         in_specs=(P(axis_name), P()),

@@ -66,6 +66,53 @@ def test_pipeline_grads_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.parametrize("n_virtual,n_micro", [(2, 4), (2, 8), (3, 4), (2, 6)])
+def test_interleaved_pipeline_matches_sequential(n_virtual, n_micro):
+    """v virtual stages per device (circular schedule) == sequential apply.
+
+    (2, 6) exercises a microbatch count that is NOT a multiple of the stage
+    count — correctness must hold even though the schedule wastes slots.
+    """
+    mesh = make_mesh(MeshSpec(pp=4))
+    dim, batch = 16, 24
+    params = _make_params(4 * n_virtual, dim, seed=4)
+    stacked = stack_stage_params(params)
+    x = jnp.asarray(np.random.RandomState(5).normal(size=(batch, dim)), jnp.float32)
+
+    out = jax.jit(
+        lambda sp, x: pipeline_apply(_stage_fn, sp, x, n_micro, mesh)
+    )(stacked, x)
+    ref = _sequential(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_interleaved_pipeline_grads_match():
+    mesh = make_mesh(MeshSpec(pp=4))
+    dim, batch = 8, 8
+    params = _make_params(8, dim, seed=6)
+    stacked = stack_stage_params(params)
+    x = jnp.asarray(np.random.RandomState(7).normal(size=(batch, dim)), jnp.float32)
+
+    def loss_pipe(sp):
+        return jnp.sum(pipeline_apply(_stage_fn, sp, x, 4, mesh) ** 2)
+
+    def loss_seq(params_list):
+        return jnp.sum(_sequential(params_list, x) ** 2)
+
+    gp = jax.jit(jax.grad(loss_pipe))(stacked)
+    gs_stacked = stack_stage_params(jax.grad(loss_seq)(params))
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pipeline_rejects_non_multiple_virtual_stages():
+    mesh = make_mesh(MeshSpec(pp=4))
+    params = stack_stage_params(_make_params(6, 8))
+    x = jnp.zeros((8, 8))
+    with pytest.raises(ValueError):
+        pipeline_apply(_stage_fn, params, x, 4, mesh)
+
+
 def test_pipeline_rejects_ragged_microbatches():
     mesh = make_mesh(MeshSpec(pp=4))
     params = stack_stage_params(_make_params(4, 8))
