@@ -267,3 +267,70 @@ class ValidExecutor(Executor):
             f"{payload.get('n', payload.get('n_pixels'))} samples"
         )
         return stats
+
+
+class GenerateExecutor(Executor):
+    """Autoregressive text/token generation from a trained LM checkpoint.
+
+    No upstream analog (the reference's infer stage is a batch forward
+    pass); this is the decode-side surface of the LLM stack.  Prompts come
+    from the configured ``infer`` (or ``valid``) split as token-id arrays;
+    sampling knobs (``max_new_tokens``, ``temperature``, ``top_k``,
+    ``top_p``, ``eos_id``, ``pad_id``) ride in the executor args.  Output:
+    an ``.npz`` of generated ids, prompt-prefix included.
+    """
+
+    name = "generate"
+
+    def work(self, ctx: ExecutionContext) -> Optional[Dict[str, Any]]:
+        from functools import partial
+
+        import jax
+
+        from mlcomp_tpu.io.checkpoint import restore_checkpoint
+        from mlcomp_tpu.models.generation import generate
+        from mlcomp_tpu.train.loop import Trainer
+
+        cfg = dict(self.args)
+        out_path = Path(cfg.pop("out", Path(ctx.workdir) / f"{ctx.task_name}_gen.npz"))
+        knobs = {
+            "max_new_tokens": int(cfg.pop("max_new_tokens", 32)),
+            "temperature": float(cfg.pop("temperature", 0.0)),
+            "top_k": cfg.pop("top_k", None),
+            "top_p": cfg.pop("top_p", None),
+            "eos_id": cfg.pop("eos_id", None),
+            "pad_id": int(cfg.pop("pad_id", 0)),
+        }
+        if knobs["top_k"] is not None:
+            knobs["top_k"] = int(knobs["top_k"])
+        if knobs["top_p"] is not None:
+            knobs["top_p"] = float(knobs["top_p"])
+        if knobs["eos_id"] is not None:
+            knobs["eos_id"] = int(knobs["eos_id"])
+        seed = int(cfg.pop("gen_seed", 0))
+
+        trainer = Trainer(cfg)
+        ckpt_dir = _find_ckpt_dir(ctx, cfg)
+        if ckpt_dir:
+            trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
+            ctx.log(f"restored checkpoint from {ckpt_dir}")
+        else:
+            ctx.log("no checkpoint found; generating with fresh params", level="warning")
+
+        split = "infer" if "infer" in trainer.loaders else "valid"
+        gen_fn = jax.jit(partial(generate, trainer.model, **knobs))
+        outs = []
+        rng = jax.random.PRNGKey(seed)
+        for batch in trainer._loader(split):
+            rng, sub = jax.random.split(rng)
+            ids = np.asarray(
+                gen_fn(trainer.state.eval_variables, prompt=batch["x"], rng=sub)
+            )
+            if "valid" in batch:
+                ids = ids[np.asarray(batch["valid"]) > 0]
+            outs.append(ids)
+        ids = np.concatenate(outs, axis=0)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        np.savez_compressed(out_path, ids=ids)
+        ctx.log(f"generated {ids.shape} token ids -> {out_path}")
+        return {"generated": str(out_path), "n": int(ids.shape[0])}

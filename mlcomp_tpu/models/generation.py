@@ -1,0 +1,182 @@
+"""Autoregressive generation: KV-cache decode loop + sampling.
+
+The upstream reference has no generative path (its infer stage is a batch
+forward pass); this module is part of the LLM-era surface the TPU build
+adds, alongside the long-context machinery.  TPU-first design:
+
+- ONE compiled step for the whole decode loop: the KV cache is a fixed
+  ``(B, prompt + budget)`` buffer (allocated via ``jax.eval_shape`` — no
+  throwaway init forward), every step updates it in place at
+  ``cache_index`` and attends under a slot mask, so shapes are static and
+  `lax.scan` drives the loop on device — zero host round-trips per token;
+- prefill and decode share the same code path (the cache write and mask
+  handle any incoming length), so the prompt is absorbed in one batched
+  MXU-friendly pass, not token by token;
+- ragged prompts batch via LEFT-padding: ``prompt_mask`` drives per-row
+  RoPE positions and masks pad slots out of attention.
+
+``generate`` is a pure function of (variables, prompt, rng) — wrap it in
+``jax.jit`` with the model/knob args static for production use (the test
+suite does exactly that).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model, batch_size: int, max_len: int) -> Dict[str, Any]:
+    """Allocate a zeroed decode cache for ``(batch_size, max_len)``.
+
+    Uses ``jax.eval_shape`` over ``model.init`` so no actual forward pass
+    (or param materialization) happens — only the cache pytree structure
+    is derived, then zeros are allocated.
+    """
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch_size, max_len), jnp.int32),
+            decode=True,
+            positions=jnp.zeros((batch_size, max_len), jnp.int32),
+        )
+    )
+    if "cache" not in shapes:
+        raise ValueError(
+            f"{type(model).__name__} creates no 'cache' collection under "
+            "decode=True; generation needs a decode-capable model"
+        )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+def process_logits(
+    logits: jax.Array,
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float],
+) -> jax.Array:
+    """Temperature/top-k/top-p filtering over (B, V) next-token logits."""
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        csum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # keep the smallest prefix whose mass reaches top_p (the first
+        # token always survives: csum - p_i is 0 mass before it)
+        keep = (csum - jax.nn.softmax(sorted_logits, axis=-1)) < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def sample_token(
+    rng: jax.Array,
+    logits: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Draw next tokens (B,) from (B, V) logits; temperature 0 = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, process_logits(logits, temperature, top_k, top_p)
+    ).astype(jnp.int32)
+
+
+def generate(
+    model,
+    variables: Dict[str, Any],
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    prompt_mask: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
+
+    - ``variables``: the model's non-cache variables ({"params": ...}).
+    - ``prompt_mask`` (B, S): True on real tokens, False on LEFT-padding;
+      pad rows get RoPE positions counted from their first real token and
+      their pad slots never attend.
+    - ``eos_id``: rows emit ``pad_id`` after producing ``eos_id``.
+
+    Returns (B, S + max_new_tokens) int32 ids (prompt included; padding
+    preserved as given).
+    """
+    prompt = prompt.astype(jnp.int32)
+    b, s = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    total = s + max_new_tokens
+    cache = init_cache(model, b, total)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    if prompt_mask is not None:
+        pm = prompt_mask.astype(jnp.bool_)
+        positions = jnp.maximum(jnp.cumsum(pm, axis=1) - 1, 0).astype(jnp.int32)
+        real_len = jnp.sum(pm, axis=1).astype(jnp.int32)  # (B,)
+        kv_mask = jnp.concatenate(
+            [pm, jnp.ones((b, max_new_tokens), jnp.bool_)], axis=1
+        )
+    else:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        real_len = jnp.full((b,), s, jnp.int32)
+        kv_mask = None
+
+    logits, updated = model.apply(
+        {**variables, "cache": cache},
+        prompt,
+        decode=True,
+        positions=positions,
+        kv_mask=kv_mask,
+        mutable=["cache"],
+    )
+    cache = updated["cache"]
+    last_logits = logits[:, -1]
+
+    def next_token(rng, logits, done):
+        tok = sample_token(rng, logits, temperature, top_k, top_p)
+        tok = jnp.where(done, jnp.int32(pad_id), tok)
+        if eos_id is not None:
+            done = done | (tok == eos_id)
+        return tok, done
+
+    def step(carry, _):
+        cache, last_logits, done, pos, rng = carry
+        rng, sub = jax.random.split(rng)
+        tok, done = next_token(sub, last_logits, done)
+        logits, updated = model.apply(
+            {**variables, "cache": cache},
+            tok[:, None],
+            decode=True,
+            positions=pos[:, None],
+            kv_mask=kv_mask,
+            mutable=["cache"],
+        )
+        return (updated["cache"], logits[:, -1], done, pos + 1, rng), tok
+
+    # N-1 scan steps (each samples, then forwards to produce the next
+    # logits); the final token needs no forward pass of its own
+    done0 = jnp.zeros((b,), jnp.bool_)
+    (_, last_logits, done, _, rng), tokens = jax.lax.scan(
+        step,
+        (cache, last_logits, done0, real_len, rng),
+        None,
+        length=max_new_tokens - 1,
+    )
+    rng, sub = jax.random.split(rng)
+    final, _ = next_token(sub, last_logits, done)
+    tokens = jnp.concatenate([tokens.T, final[:, None]], axis=1)
+    return jnp.concatenate([prompt, tokens], axis=1)

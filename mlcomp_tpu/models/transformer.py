@@ -65,7 +65,7 @@ class SelfAttention(nn.Module):
     seq_parallel: "bool | str" = False
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode=False, kv_mask=None):
         d_head = self.hidden // self.heads
         h = RMSNorm(self.dtype)(x)
         q = nn.DenseGeneral((self.heads, d_head), use_bias=False, dtype=self.dtype, name="q")(h)
@@ -73,6 +73,11 @@ class SelfAttention(nn.Module):
         v = nn.DenseGeneral((self.kv_heads, d_head), use_bias=False, dtype=self.dtype, name="v")(h)
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
+        if decode:
+            attn = self._decode_attention(q, k, v, kv_mask)
+            return x + nn.DenseGeneral(
+                self.hidden, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="out"
+            )(attn)
         # GQA: shared KV heads are broadcast inside the attention op, never
         # materialized rep× in HBM
         attn = None
@@ -103,6 +108,39 @@ class SelfAttention(nn.Module):
             self.hidden, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="out"
         )(attn)
 
+    def _decode_attention(self, q, k, v, kv_mask):
+        """Incremental attention against a KV cache (autoregressive decode).
+
+        The cache buffers are created at init time sized by the init
+        input's sequence length (= the generation budget, see
+        ``models/generation.py init_cache``); each apply writes the new
+        K/V rows at ``cache_index`` and attends q against the whole
+        buffer under a slot <= own-slot mask — fixed shapes every step,
+        so one compiled program serves the entire decode loop.
+
+        ``kv_mask`` (B, max_len) marks cache slots that are valid keys
+        (False = left-padding in a ragged prompt batch).
+        """
+        b, s, _, _ = q.shape
+        cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = index.value
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, i, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, i, 0, 0))
+        cached_k.value = k_all
+        cached_v.value = v_all
+        index.value = i + s
+        max_len = k_all.shape[1]
+        slots = jnp.arange(max_len, dtype=jnp.int32)
+        q_slots = i + jnp.arange(s, dtype=jnp.int32)
+        mask = (slots[None, :] <= q_slots[:, None])[None, None]  # (1,1,S,max)
+        if kv_mask is not None:
+            mask = mask & kv_mask[:, None, None, :].astype(jnp.bool_)
+        return dot_product_attention(q, k_all, v_all, mask=mask)
+
 
 class DecoderLayer(nn.Module):
     hidden: int
@@ -113,11 +151,11 @@ class DecoderLayer(nn.Module):
     seq_parallel: "bool | str" = False
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode=False, kv_mask=None):
         x = SelfAttention(
             self.hidden, self.heads, self.kv_heads, self.dtype,
             seq_parallel=self.seq_parallel, name="attn",
-        )(x, positions)
+        )(x, positions, decode=decode, kv_mask=kv_mask)
         h = RMSNorm(self.dtype)(x)
         gate = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype, name="gate")(h)
         up = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype, name="up")(h)
@@ -137,11 +175,30 @@ class TransformerLM(nn.Module):
     seq_parallel: "bool | str" = False
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(
+        self,
+        x,
+        train: bool = False,
+        decode: bool = False,
+        positions=None,
+        kv_mask=None,
+    ):
+        """Forward pass.  ``decode=True`` switches to incremental decoding
+        against a mutable "cache" collection (see models/generation.py);
+        ``positions`` (required then) carries each token's absolute RoPE
+        position, and ``kv_mask`` (B, max_len) masks out invalid
+        (left-pad) cache slots."""
         dtype = jnp.dtype(self.dtype)
         ids = x.astype(jnp.int32)
         b, s = ids.shape
-        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        if decode:
+            if positions is None:
+                raise ValueError(
+                    "decode=True needs explicit positions (the caller owns "
+                    "the decode cursor; see models/generation.py)"
+                )
+        elif positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
         kv_heads = self.kv_heads or self.heads
         mlp_dim = self.mlp_dim or self.hidden * 4
 
@@ -150,6 +207,6 @@ class TransformerLM(nn.Module):
             h = DecoderLayer(
                 self.hidden, self.heads, kv_heads, mlp_dim, dtype,
                 seq_parallel=self.seq_parallel,
-            )(h, positions)
+            )(h, positions, decode=decode, kv_mask=kv_mask)
         h = RMSNorm(dtype)(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(h)

@@ -127,6 +127,7 @@ def pipeline_apply(
     mesh: Mesh,
     axis_name: str = "pp",
     remat: bool = True,
+    pre_interleaved: bool = False,
 ) -> jax.Array:
     """Run ``x`` through ``V`` pipelined virtual stages on ``n_stages`` devices.
 
@@ -156,15 +157,14 @@ def pipeline_apply(
             f"{n_total} virtual stages not a multiple of {n_stages} pipeline devices"
         )
     n_virtual = n_total // n_stages
-    if n_virtual > 1:
+    if n_virtual > 1 and not pre_interleaved:
         # round-robin virtual-stage assignment: device d owns k*S + d, so
-        # reorder the stack to [d*v + k] -> k*S + d before P(pp) sharding
-        perm = jnp.asarray(
-            [k * n_stages + d for d in range(n_stages) for k in range(n_virtual)]
-        )
-        stacked_params = jax.tree.map(
-            lambda leaf: jnp.take(leaf, perm, axis=0), stacked_params
-        )
+        # reorder the stack to [d*v + k] -> k*S + d before P(pp) sharding.
+        # This gather runs INSIDE the step (params are step inputs XLA
+        # cannot hoist over); training loops should store params
+        # device-ordered via interleave_stage_params and pass
+        # pre_interleaved=True so the per-step copy disappears.
+        stacked_params = interleave_stage_params(stacked_params, n_stages)
     mb = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
 
     run = jax.shard_map(
@@ -187,3 +187,28 @@ def pipeline_apply(
 def stack_stage_params(param_list):
     """Stack per-stage param pytrees along a new leading axis for P(pp)."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+def interleave_stage_params(stacked_params, n_stages: int):
+    """Permute a network-ordered (V, ...) stack into device order.
+
+    Device ``d`` owns virtual stages ``d, S+d, 2S+d, …`` (lap order), so
+    device order is ``[d*v + k] = k*S + d``.  Apply ONCE outside the train
+    step (and keep the master copy device-ordered, passing
+    ``pre_interleaved=True`` to :func:`pipeline_apply`) — gradients then
+    come back device-ordered too, so the optimizer never sees the
+    permutation.  ``n_virtual == 1`` is the identity.
+    """
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
+    (n_total,) = leading
+    if n_total % n_stages:
+        raise ValueError(
+            f"{n_total} virtual stages not a multiple of {n_stages} pipeline devices"
+        )
+    n_virtual = n_total // n_stages
+    if n_virtual == 1:
+        return stacked_params
+    perm = jnp.asarray(
+        [k * n_stages + d for d in range(n_stages) for k in range(n_virtual)]
+    )
+    return jax.tree.map(lambda leaf: jnp.take(leaf, perm, axis=0), stacked_params)

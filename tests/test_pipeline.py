@@ -6,7 +6,11 @@ import numpy as np
 import pytest
 
 from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh
-from mlcomp_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from mlcomp_tpu.parallel.pipeline import (
+    interleave_stage_params,
+    pipeline_apply,
+    stack_stage_params,
+)
 
 
 def _stage_fn(params, h):
@@ -103,6 +107,23 @@ def test_interleaved_pipeline_grads_match():
     gs_stacked = stack_stage_params(jax.grad(loss_seq)(params))
     for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs_stacked)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pre_interleaved_params_match_network_order():
+    """Storing params device-ordered (no per-step gather) gives the same
+    result as the default network-ordered path."""
+    mesh = make_mesh(MeshSpec(pp=4))
+    dim, batch = 8, 8
+    params = _make_params(8, dim, seed=8)
+    stacked = stack_stage_params(params)
+    x = jnp.asarray(np.random.RandomState(9).normal(size=(batch, dim)), jnp.float32)
+
+    ref = pipeline_apply(_stage_fn, stacked, x, 4, mesh)
+    device_ordered = interleave_stage_params(stacked, 4)
+    out = pipeline_apply(
+        _stage_fn, device_ordered, x, 4, mesh, pre_interleaved=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 
 
 def test_pipeline_rejects_non_multiple_virtual_stages():
