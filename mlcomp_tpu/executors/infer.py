@@ -38,6 +38,22 @@ def _find_ckpt_dir(ctx: ExecutionContext, args: Dict[str, Any]) -> Optional[str]
     return None
 
 
+def _restore_trainer(ctx: ExecutionContext, cfg: Dict[str, Any], verb: str):
+    """Build a Trainer from ``cfg`` and restore the upstream checkpoint
+    (shared by infer/valid/generate so resolution can't diverge)."""
+    from mlcomp_tpu.io.checkpoint import restore_checkpoint
+    from mlcomp_tpu.train.loop import Trainer
+
+    trainer = Trainer(cfg)
+    ckpt_dir = _find_ckpt_dir(ctx, cfg)
+    if ckpt_dir:
+        trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
+        ctx.log(f"restored checkpoint from {ckpt_dir}")
+    else:
+        ctx.log(f"no checkpoint found; {verb} with fresh params", level="warning")
+    return trainer
+
+
 def _widened_sum(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Sum two confusion matrices, zero-padding the smaller one — batches
     of pre-argmaxed masks may each observe a different number of classes."""
@@ -52,18 +68,9 @@ class InferExecutor(Executor):
     name = "infer"
 
     def work(self, ctx: ExecutionContext) -> Optional[Dict[str, Any]]:
-        from mlcomp_tpu.io.checkpoint import restore_checkpoint
-        from mlcomp_tpu.train.loop import Trainer
-
         cfg = dict(self.args)
         out_path = Path(cfg.pop("out", Path(ctx.workdir) / f"{ctx.task_name}_preds.npz"))
-        trainer = Trainer(cfg)
-        ckpt_dir = _find_ckpt_dir(ctx, cfg)
-        if ckpt_dir:
-            trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
-            ctx.log(f"restored checkpoint from {ckpt_dir}")
-        else:
-            ctx.log("no checkpoint found; inferring with fresh params", level="warning")
+        trainer = _restore_trainer(ctx, cfg, "inferring")
         split = "infer" if "infer" in trainer.loaders else "valid"
         # labels (when the split has them) ride along batch-aligned, so
         # downstream scoring tasks never re-pair by dataset order
@@ -81,20 +88,9 @@ class ValidExecutor(Executor):
     name = "valid"
 
     def work(self, ctx: ExecutionContext) -> Optional[Dict[str, Any]]:
-        from mlcomp_tpu.io.checkpoint import restore_checkpoint
-        from mlcomp_tpu.train.loop import Trainer
-
         cfg = dict(self.args)
         report_cfg = cfg.pop("report", None)
-        trainer = Trainer(cfg)
-        ckpt_dir = _find_ckpt_dir(ctx, cfg)
-        if ckpt_dir:
-            trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
-            ctx.log(f"restored checkpoint from {ckpt_dir}")
-        else:
-            ctx.log(
-                "no checkpoint found; validating fresh params", level="warning"
-            )
+        trainer = _restore_trainer(ctx, cfg, "validating")
         stats = None
         if report_cfg is not None and report_cfg is not False:
             # reports are auxiliary: never fail a valid task over a
@@ -287,9 +283,7 @@ class GenerateExecutor(Executor):
 
         import jax
 
-        from mlcomp_tpu.io.checkpoint import restore_checkpoint
         from mlcomp_tpu.models.generation import generate
-        from mlcomp_tpu.train.loop import Trainer
 
         cfg = dict(self.args)
         out_path = Path(cfg.pop("out", Path(ctx.workdir) / f"{ctx.task_name}_gen.npz"))
@@ -309,14 +303,7 @@ class GenerateExecutor(Executor):
             knobs["eos_id"] = int(knobs["eos_id"])
         seed = int(cfg.pop("gen_seed", 0))
 
-        trainer = Trainer(cfg)
-        ckpt_dir = _find_ckpt_dir(ctx, cfg)
-        if ckpt_dir:
-            trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
-            ctx.log(f"restored checkpoint from {ckpt_dir}")
-        else:
-            ctx.log("no checkpoint found; generating with fresh params", level="warning")
-
+        trainer = _restore_trainer(ctx, cfg, "generating")
         split = "infer" if "infer" in trainer.loaders else "valid"
         gen_fn = jax.jit(partial(generate, trainer.model, **knobs))
         outs = []

@@ -57,21 +57,31 @@ def process_logits(
     top_k: Optional[int],
     top_p: Optional[float],
 ) -> jax.Array:
-    """Temperature/top-k/top-p filtering over (B, V) next-token logits."""
+    """Temperature/top-k/top-p filtering over (B, V) next-token logits.
+
+    ``top_p >= 1`` and ``top_k >= V`` are no-ops; ``top_p <= 0`` and
+    ``top_k <= 0`` are config errors (they would mask every token).
+    """
     logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
-    if top_k is not None and top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+    if top_k is not None:
+        if top_k <= 0:
+            raise ValueError(f"top_k must be positive, got {top_k}")
+        k = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None and top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        csum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
-        # keep the smallest prefix whose mass reaches top_p (the first
-        # token always survives: csum - p_i is 0 mass before it)
-        keep = (csum - jax.nn.softmax(sorted_logits, axis=-1)) < top_p
-        cutoff = jnp.min(
-            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    if top_p is not None:
+        if top_p <= 0.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_p < 1.0:
+            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            # keep the smallest prefix whose mass reaches top_p (the first
+            # token always survives: its exclusive-prefix mass is 0)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+            )
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return logits
 
 

@@ -38,43 +38,100 @@ def make_train_step(
     loss_fn,
     metric_fns: Dict[str, Callable],
     rng_key: Optional[jax.Array] = None,
+    grad_accum: int = 1,
 ):
     """Build the pure train step; jitted once, reused every step.
 
     ``rng_key`` seeds per-step rngs (dropout etc.), folded with the step
     counter so every step draws fresh randomness deterministically.
+
+    ``grad_accum > 1`` splits the incoming batch into that many equal
+    microbatches and runs them through a ``lax.scan`` INSIDE the one
+    jitted step — grads sum on device (fp32 accumulators), the optimizer
+    applies once, and loss/metrics report the microbatch average.  The
+    per-chip working set shrinks ``grad_accum``× while the global batch
+    (and the resulting update) is unchanged — the TPU answer to "batch
+    doesn't fit" that needs no extra processes or host round-trips.
     """
     base_key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
 
     def train_step(state: TrainState, batch):
         step_rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
 
-        def loss_of(params):
-            variables = {"params": params, **state.model_state}
-            # 'losses' is always mutable: modules sow auxiliary objectives
-            # there (e.g. MoE load-balance loss) and the step adds them in
-            outputs, new_model_state = state.apply_fn(
-                variables,
-                batch["x"],
-                train=True,
-                mutable=list(state.model_state) + ["losses"],
-                rngs=step_rngs,
-            )
-            new_model_state = dict(new_model_state)
-            sown = new_model_state.pop("losses", {})
-            loss = loss_fn(outputs, batch)
-            for leaf in jax.tree.leaves(sown):
-                loss = loss + jnp.sum(leaf)
-            return loss, (outputs, new_model_state)
+        def grads_of(params, model_state, batch, step_rngs):
+            def loss_of(params):
+                variables = {"params": params, **model_state}
+                # 'losses' is always mutable: modules sow auxiliary
+                # objectives there (e.g. MoE load-balance loss) and the
+                # step adds them in
+                outputs, new_model_state = state.apply_fn(
+                    variables,
+                    batch["x"],
+                    train=True,
+                    mutable=list(model_state) + ["losses"],
+                    rngs=step_rngs,
+                )
+                new_model_state = dict(new_model_state)
+                sown = new_model_state.pop("losses", {})
+                loss = loss_fn(outputs, batch)
+                for leaf in jax.tree.leaves(sown):
+                    loss = loss + jnp.sum(leaf)
+                return loss, (outputs, new_model_state)
 
-        (loss, (outputs, new_model_state)), grads = jax.value_and_grad(
-            loss_of, has_aux=True
-        )(state.params)
+            (loss, (outputs, new_model_state)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            stats = {"loss": loss}
+            for name, fn in metric_fns.items():
+                stats[name] = fn(outputs, batch)
+            return grads, new_model_state, stats
+
+        if grad_accum == 1:
+            grads, new_model_state, stats = grads_of(
+                state.params, state.model_state, batch, step_rngs
+            )
+            new_state = state.apply_gradients(
+                grads, new_model_state=new_model_state
+            )
+            return new_state, stats
+
+        def split(x):
+            b = x.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch size {b} not divisible by grad_accum={grad_accum}"
+                )
+            return x.reshape(grad_accum, b // grad_accum, *x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, mb_and_idx):
+            acc, model_state = carry
+            mb, idx = mb_and_idx
+            rngs = {
+                k: jax.random.fold_in(v, idx) for k, v in step_rngs.items()
+            }
+            grads, model_state, stats = grads_of(
+                state.params, model_state, mb, rngs
+            )
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads
+            )
+            return (acc, model_state), stats
+
+        acc0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (acc, new_model_state), stats = jax.lax.scan(
+            body,
+            (acc0, state.model_state),
+            (micro, jnp.arange(grad_accum)),
+        )
+        grads = jax.tree.map(
+            lambda a, p: (a / grad_accum).astype(p.dtype), acc, state.params
+        )
         new_state = state.apply_gradients(grads, new_model_state=new_model_state)
-        stats = {"loss": loss}
-        for name, fn in metric_fns.items():
-            stats[name] = fn(outputs, batch)
-        return new_state, stats
+        return new_state, jax.tree.map(jnp.mean, stats)
 
     return train_step
 
@@ -105,7 +162,8 @@ class Trainer:
     """Config-driven trainer used by the train executor and the bench.
 
     cfg keys: model{name,...}, optimizer{name,lr,...}, loss, metrics[list],
-    data{train{...}, valid{...}}, epochs, batch_size, seed, mesh{dp,...}.
+    data{train{...}, valid{...}}, epochs, batch_size, seed, mesh{dp,...},
+    grad_accum (microbatch count per update; default 1).
     """
 
     def __init__(self, cfg: Dict[str, Any], mesh=None):
@@ -185,6 +243,7 @@ class Trainer:
                 self.loss_fn,
                 self.metric_fns,
                 rng_key=jax.random.PRNGKey(self.seed + 1),
+                grad_accum=int(cfg.get("grad_accum", 1)),
             ),
             donate_argnums=(0,),
         )

@@ -113,6 +113,17 @@ def test_process_logits_top_k_top_p():
     assert int(jnp.argmax(top2)) == 0
 
 
+def test_process_logits_rejects_degenerate_knobs():
+    logits = jnp.zeros((1, 8))
+    with pytest.raises(ValueError):
+        process_logits(logits, 1.0, 0, None)
+    with pytest.raises(ValueError):
+        process_logits(logits, 1.0, None, 0.0)
+    # over-large top_k clamps to vocab instead of crashing lax.top_k
+    out = process_logits(logits, 1.0, 100, None)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_sample_token_greedy_is_argmax():
     logits = jnp.asarray([[0.1, 2.0, 0.3], [5.0, 0.0, -1.0]])
     tok = sample_token(jax.random.PRNGKey(0), logits, temperature=0.0)
@@ -156,7 +167,9 @@ def test_generate_executor_writes_ids(tmp_path):
         )
     )
     ids = np.load(out)["ids"]
-    assert ids.shape == (6, 12)  # 8 prompt + 4 generated, tail batch unpadded
+    # 8 prompt + 4 generated; the loader pads the 6-row tail to batch_size 8
+    # and the executor drops the pad rows via the batch's 'valid' mask
+    assert ids.shape == (6, 12)
     assert res["n"] == 6
 
 

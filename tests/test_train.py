@@ -235,3 +235,72 @@ def test_ema_tracked_and_used_for_eval():
     tr2 = Trainer(mlp_cfg(epochs=1))
     assert tr2.state.ema_params is None
     assert tr2.state.eval_variables["params"] is tr2.state.params
+
+
+def test_grad_accum_matches_full_batch():
+    """N-microbatch accumulation must produce the same update as one big
+    batch (mean losses, equal micro sizes, no batch-dependent layers)."""
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.train.loop import make_train_step
+    from mlcomp_tpu.train.losses import create_loss
+    from mlcomp_tpu.train.optim import create_optimizer
+    from mlcomp_tpu.train.state import TrainState, init_model
+
+    model = create_model({"name": "mlp", "num_classes": 4, "hidden": [16]})
+    rs = np.random.RandomState(0)
+    batch = {
+        "x": jnp.asarray(rs.normal(size=(16, 8)), jnp.float32),
+        "y": jnp.asarray(rs.randint(0, 4, size=(16,))),
+    }
+    loss_fn = create_loss("cross_entropy")
+
+    def run(ga):
+        params, model_state = init_model(
+            model, {"x": batch["x"][:1]}, jax.random.PRNGKey(0)
+        )
+        tx = create_optimizer({"name": "sgd", "lr": 0.1})
+        state = TrainState.create(model.apply, params, tx, model_state)
+        step = jax.jit(make_train_step(loss_fn, {}, grad_accum=ga))
+        state, stats = step(state, batch)
+        return state, stats
+
+    s1, st1 = run(1)
+    s4, st4 = run(4)
+    np.testing.assert_allclose(float(st1["loss"]), float(st4["loss"]), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_trainer_grad_accum_config():
+    cfg = mlp_cfg()
+    cfg["grad_accum"] = 2
+    tr = Trainer(cfg)
+    stats = tr.train_epoch()
+    assert np.isfinite(stats["loss"])
+    assert int(tr.state.step) == tr.steps_per_epoch  # one update per batch
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.train.loop import make_train_step
+    from mlcomp_tpu.train.losses import create_loss
+    from mlcomp_tpu.train.optim import create_optimizer
+    from mlcomp_tpu.train.state import TrainState, init_model
+
+    model = create_model({"name": "mlp", "num_classes": 4, "hidden": [8]})
+    batch = {
+        "x": jnp.zeros((10, 8), jnp.float32),
+        "y": jnp.zeros((10,), jnp.int32),
+    }
+    params, model_state = init_model(
+        model, {"x": batch["x"][:1]}, jax.random.PRNGKey(0)
+    )
+    tx = create_optimizer({"name": "sgd", "lr": 0.1})
+    state = TrainState.create(model.apply, params, tx, model_state)
+    step = jax.jit(make_train_step(create_loss("cross_entropy"), {}, grad_accum=4))
+    with pytest.raises(ValueError):
+        step(state, batch)
