@@ -83,23 +83,41 @@ def _cmd_status(args: argparse.Namespace) -> int:
         store.close()
 
 
+def _dag_or_task(args: argparse.Namespace) -> bool:
+    """stop/restart target validation: exactly one of DAG or --task."""
+    if (args.dag is None) == (args.task is None):
+        print("error: give either a DAG id or --task TASK_ID", file=sys.stderr)
+        return False
+    return True
+
+
 def _cmd_stop(args: argparse.Namespace) -> int:
     from mlcomp_tpu.db.store import Store
 
+    if not _dag_or_task(args):
+        return 2
     store = Store(args.db)
-    n = store.stop_dag(args.dag)
+    if args.task is not None:
+        out = {"task_id": args.task, "stopped": store.stop_task(args.task)}
+    else:
+        out = {"dag_id": args.dag, "stopped_tasks": store.stop_dag(args.dag)}
     store.close()
-    print(json.dumps({"dag_id": args.dag, "stopped_tasks": n}))
+    print(json.dumps(out))
     return 0
 
 
 def _cmd_restart(args: argparse.Namespace) -> int:
     from mlcomp_tpu.db.store import Store
 
+    if not _dag_or_task(args):
+        return 2
     store = Store(args.db)
-    n = store.restart_dag(args.dag)
+    if args.task is not None:
+        out = {"task_id": args.task, "reset_tasks": store.restart_task(args.task)}
+    else:
+        out = {"dag_id": args.dag, "reset_tasks": store.restart_dag(args.dag)}
     store.close()
-    print(json.dumps({"dag_id": args.dag, "reset_tasks": n}))
+    print(json.dumps(out))
     return 0
 
 
@@ -156,13 +174,22 @@ def main(argv=None) -> int:
     st.add_argument("--db", default="mlcomp.sqlite")
     st.set_defaults(fn=_cmd_status)
 
-    sp = sub.add_parser("stop", help="stop a DAG (unfinished tasks -> stopped)")
-    sp.add_argument("dag", type=int)
+    sp = sub.add_parser(
+        "stop", help="stop a DAG (unfinished tasks -> stopped) or one --task"
+    )
+    sp.add_argument("dag", nargs="?", type=int, default=None)
+    sp.add_argument("--task", type=int, default=None, help="stop one task by id")
     sp.add_argument("--db", default="mlcomp.sqlite")
     sp.set_defaults(fn=_cmd_stop)
 
-    rs = sub.add_parser("restart", help="re-run a DAG's unsuccessful tasks")
-    rs.add_argument("dag", type=int)
+    rs = sub.add_parser(
+        "restart", help="re-run a DAG's unsuccessful tasks, or one --task"
+    )
+    rs.add_argument("dag", nargs="?", type=int, default=None)
+    rs.add_argument(
+        "--task", type=int, default=None,
+        help="re-run one finished task (plus its skipped dependents)",
+    )
     rs.add_argument("--db", default="mlcomp.sqlite")
     rs.set_defaults(fn=_cmd_restart)
 

@@ -296,6 +296,94 @@ class Store:
             )
             return cur.rowcount
 
+    def stop_task(self, task_id: int) -> bool:
+        """Stop ONE task (not_ran/queued/in_progress → stopped).
+
+        The DAG stays in_progress: the supervisor's next tick dooms the
+        task's dependents (skip) and the normal rollup finalizes the DAG.
+        Same late-``finish_task`` safety as :meth:`stop_dag` — a worker
+        mid-task can't clobber the stop."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE tasks SET status=?, finished=? WHERE id=?"
+                " AND status IN (?,?,?)",
+                (
+                    TaskStatus.STOPPED.value,
+                    time.time(),
+                    task_id,
+                    TaskStatus.NOT_RAN.value,
+                    TaskStatus.QUEUED.value,
+                    TaskStatus.IN_PROGRESS.value,
+                ),
+            )
+            return cur.rowcount > 0
+
+    def restart_task(self, task_id: int) -> int:
+        """Re-run ONE finished task.
+
+        Resets the task (fresh retry budget) plus any transitive
+        dependents that are SKIPPED (doomed by this task's outcome),
+        QUEUED, or IN_PROGRESS — the latter two must not run against the
+        about-to-be-rewritten upstream output, so they are pulled back to
+        NOT_RAN and re-queue only after the restarted task succeeds (a
+        worker already mid-dependent keeps computing, but its late finish
+        is a conditional update on status=in_progress and cannot land).
+        Dependents that finished keep their results; ones skipped because
+        of a *different* failed upstream get re-doomed by the supervisor
+        on its next tick.  The DAG reopens to in_progress.  Returns tasks
+        reset (0 when the task is not in a restartable status)."""
+        restartable = (
+            TaskStatus.FAILED.value,
+            TaskStatus.SKIPPED.value,
+            TaskStatus.STOPPED.value,
+            TaskStatus.SUCCESS.value,
+        )
+        dependent_reset = (
+            TaskStatus.SKIPPED.value,
+            TaskStatus.QUEUED.value,
+            TaskStatus.IN_PROGRESS.value,
+        )
+        with self._tx() as c:
+            row = c.execute(
+                "SELECT dag_id, name, status FROM tasks WHERE id=?", (task_id,)
+            ).fetchone()
+            if row is None or row["status"] not in restartable:
+                return 0
+            dag_id = row["dag_id"]
+            rows = c.execute(
+                "SELECT id, name, depends, status FROM tasks WHERE dag_id=?",
+                (dag_id,),
+            ).fetchall()
+            children: Dict[str, List[sqlite3.Row]] = {}
+            for r in rows:
+                for dep in json.loads(r["depends"]):
+                    children.setdefault(dep, []).append(r)
+            to_reset = [task_id]
+            frontier, seen = [row["name"]], {row["name"]}
+            while frontier:
+                nxt = []
+                for name in frontier:
+                    for r in children.get(name, []):
+                        if r["name"] in seen:
+                            continue
+                        seen.add(r["name"])
+                        if r["status"] in dependent_reset:
+                            to_reset.append(r["id"])
+                        nxt.append(r["name"])
+                frontier = nxt
+            marks = ",".join("?" * len(to_reset))
+            cur = c.execute(
+                f"UPDATE tasks SET status=?, worker=NULL, started=NULL,"
+                f" finished=NULL, error=NULL, retries=0 WHERE id IN ({marks})",
+                (TaskStatus.NOT_RAN.value, *to_reset),
+            )
+            c.execute(
+                "UPDATE dags SET status='in_progress' WHERE id=?"
+                " AND status IN ('stopped','failed','success')",
+                (dag_id,),
+            )
+            return cur.rowcount
+
     def list_dags(self) -> List[Dict[str, Any]]:
         rows = self._conn.execute(
             "SELECT id, name, project, status, created FROM dags ORDER BY id"

@@ -28,6 +28,8 @@ from mlcomp_tpu.db.store import Store
 _POST_ROUTES = [
     (re.compile(r"^/api/dags/(\d+)/stop$"), "stop_dag"),
     (re.compile(r"^/api/dags/(\d+)/restart$"), "restart_dag"),
+    (re.compile(r"^/api/tasks/(\d+)/stop$"), "stop_task"),
+    (re.compile(r"^/api/tasks/(\d+)/restart$"), "restart_task"),
 ]
 
 _ROUTES = [
@@ -307,10 +309,17 @@ async function refresh(){
   drawGraph(tasks);
   refreshCompare();
   const tt=document.getElementById('tasks');tt.innerHTML='';
-  row(tt,['id','name','executor','stage','status','worker','error'],true);
+  row(tt,['id','name','executor','stage','status','worker','error','actions'],true);
+  const tact=x=>{const span=document.createElement('span');
+   const P=(verb)=>fetch('/api/tasks/'+x.id+'/'+verb,{method:'POST',
+    headers:{'X-Requested-With':'mlcomp-tpu'}}).then(()=>refresh());
+   if(['not_ran','queued','in_progress'].includes(x.status))
+    span.appendChild(link('stop',()=>P('stop')));
+   else span.appendChild(link('restart',()=>P('restart')));
+   return span};
   for(const x of tasks)
    row(tt,[link(x.id,()=>showTask(x.id)),x.name,x.executor,x.stage,
-    [x.status,x.status],x.worker||'',x.error||'']);}
+    [x.status,x.status],x.worker||'',x.error||'',tact(x)]);}
  const ws=await J('/api/workers');const wt=document.getElementById('workers');
  wt.innerHTML='';row(wt,['name','chips','busy','status','heartbeat'],true);
  for(const w of ws)row(wt,[w.name,w.chips,w.busy_chips,
@@ -437,6 +446,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _r_restart_dag(self, store: Store, dag_id: str):
         return {"dag_id": int(dag_id), "reset_tasks": store.restart_dag(int(dag_id))}
+
+    def _r_stop_task(self, store: Store, task_id: str):
+        return {"task_id": int(task_id), "stopped": store.stop_task(int(task_id))}
+
+    def _r_restart_task(self, store: Store, task_id: str):
+        return {"task_id": int(task_id), "reset_tasks": store.restart_task(int(task_id))}
 
     def _r_workers(self, store: Store):
         return store.workers()

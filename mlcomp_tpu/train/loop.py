@@ -50,8 +50,14 @@ def make_train_step(
     jitted step — grads sum on device (fp32 accumulators), the optimizer
     applies once, and loss/metrics report the microbatch average.  The
     per-chip working set shrinks ``grad_accum``× while the global batch
-    (and the resulting update) is unchanged — the TPU answer to "batch
-    doesn't fit" that needs no extra processes or host round-trips.
+    is unchanged — the TPU answer to "batch doesn't fit" that needs no
+    extra processes or host round-trips.  For plain mean losses the
+    update matches the full-batch step exactly; for masked losses
+    (ignore labels, tail-batch ``valid`` masks) each microbatch's mean
+    contributes equally, so tokens in sparse microbatches weigh more
+    than full-batch token-mean would give them — the standard
+    microbatch-mean semantics, stated here because it is NOT bit-equal
+    when valid counts vary across the split.
     """
     base_key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
 

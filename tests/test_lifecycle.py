@@ -212,3 +212,134 @@ def test_restart_reopens_stopped_dag_with_all_tasks_succeeded(tmp_db):
     assert store.dag_status(dag_id) == "in_progress"  # ...but reopened
     assert sup.tick()[dag_id] == "success"
     store.close()
+
+
+def _task_ids(store, dag_id):
+    return {r["name"]: r["id"] for r in store.task_rows(dag_id)}
+
+
+def test_stop_single_task_dooms_dependents(tmp_db):
+    from mlcomp_tpu.executors import load_all
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = _chain(store)
+    sup = Supervisor(store, worker_timeout_s=30)
+    sup.tick()
+    ids = _task_ids(store, dag_id)
+    assert store.stop_task(ids["t1"])
+    w = Worker(store, name="w", chips=0, load_jax_executors=False)
+    for _ in range(6):
+        status = sup.tick()[dag_id]
+        if status != "in_progress":
+            break
+        while w.run_once():
+            pass
+    sts = store.task_statuses(dag_id)
+    assert sts["t0"] == TaskStatus.SUCCESS  # untouched branch still ran
+    assert sts["t1"] == TaskStatus.STOPPED
+    assert sts["t2"] == TaskStatus.SKIPPED  # doomed by the stopped parent
+    assert status == "failed"
+    store.close()
+
+
+def test_restart_task_resets_skipped_dependents(tmp_db):
+    from mlcomp_tpu.executors import load_all
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = _chain(store, n=3, fail_at=1)
+    sup = Supervisor(store, worker_timeout_s=30)
+    w = Worker(store, name="w", chips=0, load_jax_executors=False)
+    for _ in range(6):
+        if sup.tick()[dag_id] != "in_progress":
+            break
+        while w.run_once():
+            pass
+    ids = _task_ids(store, dag_id)
+    n = store.restart_task(ids["t1"])
+    assert n == 2  # t1 + its skipped dependent t2; successful t0 kept
+    sts = store.task_statuses(dag_id)
+    assert sts["t0"] == TaskStatus.SUCCESS
+    assert sts["t1"] == TaskStatus.NOT_RAN
+    assert sts["t2"] == TaskStatus.NOT_RAN
+    assert store.dag_status(dag_id) == "in_progress"
+    store.close()
+
+
+def test_restart_task_rejects_unfinished(tmp_db):
+    store = Store(tmp_db)
+    dag_id = _chain(store)
+    ids = _task_ids(store, dag_id)
+    assert store.restart_task(ids["t0"]) == 0  # not_ran: nothing to redo
+    assert store.stop_task(ids["t0"])
+    assert not store.stop_task(ids["t0"])  # already stopped: no-op
+    assert store.restart_task(ids["t0"]) == 1
+    store.close()
+
+
+def test_cli_per_task_stop_restart(tmp_db, capsys):
+    from mlcomp_tpu.cli import main
+
+    store = Store(tmp_db)
+    dag_id = _chain(store)
+    ids = _task_ids(store, dag_id)
+    store.close()
+    assert main(["stop", "--task", str(ids["t1"]), "--db", tmp_db]) == 0
+    assert json.loads(capsys.readouterr().out)["stopped"] is True
+    assert main(["restart", "--task", str(ids["t1"]), "--db", tmp_db]) == 0
+    assert json.loads(capsys.readouterr().out)["reset_tasks"] == 1
+    # exactly one of dag / --task must be given
+    assert main(["stop", "--db", tmp_db]) == 2
+    assert main(["stop", str(dag_id), "--task", "1", "--db", tmp_db]) == 2
+
+
+def test_http_per_task_stop_restart(tmp_db):
+    from mlcomp_tpu.report.server import start_in_thread
+
+    store = Store(tmp_db)
+    dag_id = _chain(store)
+    ids = _task_ids(store, dag_id)
+    srv, port = start_in_thread(tmp_db)
+    try:
+        def post(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", method="POST",
+                headers={"X-Requested-With": "mlcomp-tpu"},
+            )
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        assert post(f"/api/tasks/{ids['t2']}/stop")["stopped"] is True
+        assert store.task_statuses(dag_id)["t2"] == TaskStatus.STOPPED
+        assert post(f"/api/tasks/{ids['t2']}/restart")["reset_tasks"] == 1
+        assert store.task_statuses(dag_id)["t2"] == TaskStatus.NOT_RAN
+    finally:
+        srv.shutdown()
+        store.close()
+
+
+def test_restart_task_pulls_back_queued_dependents(tmp_db):
+    """Restarting a succeeded task must de-queue dependents so they cannot
+    run against the upstream output while it is being rewritten."""
+    from mlcomp_tpu.executors import load_all
+
+    load_all()
+    store = Store(tmp_db)
+    dag_id = _chain(store)
+    sup = Supervisor(store, worker_timeout_s=30)
+    w = Worker(store, name="w", chips=0, load_jax_executors=False)
+    sup.tick()            # t0 queued
+    while w.run_once():   # t0 success
+        pass
+    sup.tick()            # t1 queued
+    ids = _task_ids(store, dag_id)
+    assert store.task_statuses(dag_id)["t1"] == TaskStatus.QUEUED
+    n = store.restart_task(ids["t0"])
+    assert n == 2  # t0 + queued dependent t1
+    sts = store.task_statuses(dag_id)
+    assert sts["t0"] == TaskStatus.NOT_RAN
+    assert sts["t1"] == TaskStatus.NOT_RAN
+    # a worker cannot claim anything until the supervisor re-queues t0
+    assert store.claim_task("w", free_chips=0, free_hosts=1) is None
+    store.close()
