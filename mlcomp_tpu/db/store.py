@@ -222,6 +222,14 @@ class Store:
             raise KeyError(f"no dag {dag_id}")
         return row["status"]
 
+    def dag_created(self, dag_id: int) -> Optional[float]:
+        """Submit timestamp of one DAG (None if unknown) — the stable
+        component of the model-storage namespace."""
+        row = self._conn.execute(
+            "SELECT created FROM dags WHERE id=?", (dag_id,)
+        ).fetchone()
+        return None if row is None else float(row["created"])
+
     def set_dag_status(
         self, dag_id: int, status: str, expect: Optional[str] = None
     ) -> bool:
@@ -323,7 +331,9 @@ class Store:
 
         Resets the task (fresh retry budget) plus any transitive
         dependents that are SKIPPED (doomed by this task's outcome),
-        QUEUED, or IN_PROGRESS — the latter two must not run against the
+        FAILED (possibly by this task's bad output — matching
+        ``restart_dag``, which also re-runs failures), QUEUED, or
+        IN_PROGRESS — the latter two must not run against the
         about-to-be-rewritten upstream output, so they are pulled back to
         NOT_RAN and re-queue only after the restarted task succeeds (a
         worker already mid-dependent keeps computing, but its late finish
@@ -342,6 +352,7 @@ class Store:
             TaskStatus.SKIPPED.value,
             TaskStatus.QUEUED.value,
             TaskStatus.IN_PROGRESS.value,
+            TaskStatus.FAILED.value,
         )
         with self._tx() as c:
             row = c.execute(

@@ -32,7 +32,20 @@ class TrainExecutor(Executor):
         cfg = dict(self.args)
         storage = ModelStorage(cfg.pop("storage_root", None))
         project = cfg.pop("project", "default")
-        dag_name = cfg.pop("dag_name", f"dag{ctx.dag_id}")
+        # Default storage namespace: dag id + the dag row's creation time.
+        # The id alone collides across independent submissions (every fresh
+        # local-runner db starts at dag 1, same project/task names), which
+        # made a second run "resume" the first run's incompatible
+        # checkpoint.  The timestamp is stable across restarts/requeues of
+        # the SAME dag row, so intentional resume still works; an explicit
+        # dag_name arg opts into cross-run sharing.
+        dag_name = cfg.pop("dag_name", None)
+        if dag_name is None:
+            dag_name = f"dag{ctx.dag_id}"
+            if ctx.store is not None:
+                created = ctx.store.dag_created(ctx.dag_id)
+                if created is not None:
+                    dag_name = f"dag{ctx.dag_id}-{int(created * 1000)}"
         ckpt_dir = storage.checkpoint_dir(project, dag_name, ctx.task_name)
         # Catalyst parity (main_metric/minimize_metric): track the best
         # epoch by a named metric and keep its checkpoint separately

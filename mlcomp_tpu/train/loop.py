@@ -201,6 +201,11 @@ class Trainer:
             self.trace_path = tc.get("path", "trace.json")
             self.tracer = Tracer(self.trace_path)
 
+        # device profiling: cfg profile: {dir, start_step, num_steps}
+        from mlcomp_tpu.utils.profile import create_profiler
+
+        self.profiler = create_profiler(cfg.get("profile"))
+
         datasets = cfg.get("data", {})
         self.loaders: Dict[str, DataLoader] = {}
         for split, dcfg in datasets.items():
@@ -271,6 +276,8 @@ class Trainer:
         agg: Dict[str, Any] = {}
         n = 0
         tracer = self.tracer if self.tracer is not None else get_tracer()
+        # one host sync per epoch for the profiler's step-window arithmetic
+        global_step = int(self.state.step) if self.profiler else 0
         it = iter(self._loader("train"))
         while True:
             # separate data/step spans: a fat "data" track means the input
@@ -280,11 +287,15 @@ class Trainer:
                 batch = next(it, None)
             if batch is None:
                 break
+            if self.profiler:
+                self.profiler.step(global_step + n)
             with tracer.span("step", n=n):
                 self.state, stats = self._train_step(self.state, batch)
             for k, v in stats.items():
                 agg[k] = agg.get(k, 0.0) + v  # device-side accumulation
             n += 1
+        if self.profiler:
+            self.profiler.flush()  # stop-only: eval work stays out of the trace
         return {k: float(v) / max(n, 1) for k, v in agg.items()}
 
     def eval_epoch(self, split: str = "valid") -> Dict[str, float]:
@@ -370,6 +381,8 @@ class Trainer:
         finally:
             if self.tracer is not None:
                 set_tracer(None)
+            if self.profiler is not None:
+                self.profiler.close()
         if self.trace_path and self.tracer is not None:
             self.tracer.save(self.trace_path)
         return last

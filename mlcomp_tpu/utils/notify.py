@@ -75,6 +75,34 @@ class WebhookNotifier(Notifier):
         urllib.request.urlopen(req, timeout=self.timeout_s).read()
 
 
+@NOTIFIERS.register("telegram")
+class TelegramNotifier(Notifier):
+    """Telegram Bot API sink (the upstream reference's ancestry ships a
+    telegram bot for task notifications).  Needs a bot ``token`` and a
+    ``chat_id``; on zero-egress fleets the send fails and ``notify_all``
+    logs-and-swallows it like any other sink error."""
+
+    def __init__(self, token: str, chat_id: str, timeout_s: float = 10.0, **_):
+        if not token or not chat_id:
+            raise ValueError("telegram notifier needs both token and chat_id")
+        self.url = f"https://api.telegram.org/bot{token}/sendMessage"
+        self.chat_id = str(chat_id)
+        self.timeout_s = timeout_s
+
+    def send(self, event: Dict[str, Any]) -> None:
+        detail = {k: v for k, v in event.items() if k not in ("event", "ts")}
+        text = f"[{event['event']}] {json.dumps(detail, default=str)}"
+        # Bot API hard limit; an over-long traceback must not cost the
+        # notification itself (400 "message is too long")
+        text = text[:4096]
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps({"chat_id": self.chat_id, "text": text}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+
+
 def create_notifiers(cfgs: Optional[List[Dict[str, Any]]]) -> List[Notifier]:
     """[{type: file, path: ...}, {type: command, cmd: ...}] → notifiers."""
     out: List[Notifier] = []

@@ -1,0 +1,79 @@
+"""Device profiling: capture a window of train steps with ``jax.profiler``.
+
+The host-side span tracer (utils/trace.py) answers "is the input pipeline
+starving the chips"; this module answers "what is the chip doing inside a
+step" — XLA op timeline, fusion boundaries, HBM traffic — by wrapping
+``jax.profiler.start_trace``/``stop_trace`` around a configured step
+window.  Output is a TensorBoard-loadable trace directory (also readable
+with ``xprof``).
+
+Trainer config::
+
+    profile: {dir: prof/, start_step: 5, num_steps: 3}
+
+A short window a few steps in is the TPU idiom: step 0 pays compilation,
+steps 1–2 warm caches; profiling [5, 8) records steady state without
+drowning the trace in warmup noise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class StepProfiler:
+    """Start/stop ``jax.profiler`` around a global-step window.
+
+    Call :meth:`step` with the upcoming global step number right before
+    each train step; the profiler starts at ``start_step`` and stops
+    after ``num_steps`` steps (or at :meth:`close`, whichever is first).
+    Safe on resume: a restored trainer whose step counter is already past
+    the window never starts a trace.
+    """
+
+    def __init__(self, dir: str, start_step: int = 5, num_steps: int = 3):
+        self.dir = str(dir)
+        self.start_step = int(start_step)
+        self.stop_step = self.start_step + int(num_steps)
+        self._active = False
+        self._done = False
+
+    def step(self, global_step: int) -> None:
+        import jax
+
+        if not self._done and not self._active and (
+            self.start_step <= global_step < self.stop_step
+        ):
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+        elif self._active and global_step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def flush(self) -> None:
+        """Stop-only boundary (end of epoch): closes a window that is
+        mid-capture so eval/checkpoint work never pollutes the trace, and
+        never starts a new one."""
+        self.close()
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+
+def create_profiler(cfg: Optional[Dict[str, Any]]) -> Optional[StepProfiler]:
+    """``profile: {dir, start_step, num_steps}`` (or ``true``) → profiler."""
+    if not cfg:
+        return None
+    if cfg is True:
+        cfg = {}
+    return StepProfiler(
+        dir=cfg.get("dir", "profile"),
+        start_step=int(cfg.get("start_step", 5)),
+        num_steps=int(cfg.get("num_steps", 3)),
+    )

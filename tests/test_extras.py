@@ -1,0 +1,158 @@
+"""Telegram notify sink, kaggle executors (gated), step profiler."""
+
+import json
+import os
+import stat
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.executors import load_all
+from mlcomp_tpu.executors.base import ExecutionContext, create_executor
+from mlcomp_tpu.utils.notify import create_notifiers, notify_all
+
+
+def test_telegram_notifier_posts_bot_api(monkeypatch):
+    sent = {}
+
+    def fake_urlopen(req, timeout=None):
+        sent["url"] = req.full_url
+        sent["body"] = json.loads(req.data)
+
+        class R:
+            def read(self):
+                return b"{}"
+
+        return R()
+
+    import mlcomp_tpu.utils.notify as notify
+
+    monkeypatch.setattr(notify.urllib.request, "urlopen", fake_urlopen)
+    (n,) = create_notifiers([{"type": "telegram", "token": "T0K", "chat_id": 42}])
+    notify_all([n], "dag_finished", dag_id=7, status="success")
+    assert sent["url"] == "https://api.telegram.org/botT0K/sendMessage"
+    assert sent["body"]["chat_id"] == "42"
+    assert "dag_finished" in sent["body"]["text"]
+    assert '"dag_id": 7' in sent["body"]["text"]
+
+
+def test_telegram_notifier_requires_token_and_chat():
+    with pytest.raises(ValueError):
+        create_notifiers([{"type": "telegram", "token": "", "chat_id": "x"}])
+
+
+def _ctx(tmp_path, args):
+    return ExecutionContext(
+        dag_id=1, task_id=1, task_name="k", args=args, workdir=str(tmp_path)
+    )
+
+
+def test_kaggle_executor_gated_without_cli(tmp_path, monkeypatch):
+    load_all()
+    monkeypatch.setenv("PATH", str(tmp_path))  # no kaggle binary anywhere
+    ex = create_executor("kaggle_download", {"competition": "titanic"})
+    with pytest.raises(RuntimeError, match="kaggle CLI"):
+        ex.work(_ctx(tmp_path, ex.args))
+
+
+def _fake_kaggle(tmp_path, log_name="kaggle.log"):
+    """A stand-in 'kaggle' binary that records its argv."""
+    log = tmp_path / log_name
+    binary = tmp_path / "kaggle"
+    binary.write_text(
+        "#!/bin/sh\n"
+        f'echo "$@" >> {log}\n'
+        'echo "ok"\n'
+    )
+    binary.chmod(binary.stat().st_mode | stat.S_IEXEC)
+    return binary, log
+
+
+def test_kaggle_download_invokes_cli(tmp_path, monkeypatch):
+    load_all()
+    binary, log = _fake_kaggle(tmp_path)
+    monkeypatch.setenv("KAGGLE_USERNAME", "u")
+    monkeypatch.setenv("KAGGLE_KEY", "k")
+    out = tmp_path / "data"
+    ex = create_executor(
+        "kaggle_download",
+        {"competition": "titanic", "out": str(out), "kaggle_bin": str(binary)},
+    )
+    res = ex.work(_ctx(tmp_path, ex.args))
+    assert res["path"] == str(out)
+    argv = log.read_text().strip()
+    assert argv.startswith("competitions download -c titanic")
+    assert str(out) in argv
+
+
+def test_kaggle_submit_follows_dependency_result(tmp_path, monkeypatch):
+    load_all()
+    binary, log = _fake_kaggle(tmp_path)
+    monkeypatch.setenv("KAGGLE_USERNAME", "u")
+    monkeypatch.setenv("KAGGLE_KEY", "k")
+    ex = create_executor(
+        "kaggle_submit",
+        {
+            "competition": "titanic",
+            "file": str(tmp_path / "preds.csv"),
+            "message": "run 1",
+            "kaggle_bin": str(binary),
+        },
+    )
+    res = ex.work(_ctx(tmp_path, ex.args))
+    argv = log.read_text().strip()
+    assert "competitions submit -c titanic" in argv
+    assert "run 1" in argv
+    assert res["output"] == "ok"
+
+
+def test_kaggle_download_rejects_both_sources(tmp_path):
+    load_all()
+    ex = create_executor(
+        "kaggle_download", {"competition": "a", "dataset": "b"}
+    )
+    with pytest.raises(ValueError, match="exactly one"):
+        ex.work(_ctx(tmp_path, ex.args))
+
+
+def test_step_profiler_writes_trace(tmp_path):
+    from mlcomp_tpu.utils.profile import StepProfiler
+
+    import jax
+    import jax.numpy as jnp
+
+    prof = StepProfiler(str(tmp_path / "prof"), start_step=1, num_steps=2)
+    f = jax.jit(lambda x: x * 2 + 1)
+    for step in range(5):
+        prof.step(step)
+        f(jnp.ones((8, 8))).block_until_ready()
+    prof.close()
+    produced = list((tmp_path / "prof").rglob("*"))
+    assert any(p.is_file() for p in produced)  # a trace landed on disk
+
+
+def test_trainer_profile_config(tmp_path):
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "mlp", "num_classes": 4, "hidden": [8]},
+        "optimizer": {"name": "sgd", "lr": 0.1},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "epochs": 1,
+        "profile": {"dir": str(tmp_path / "prof"), "start_step": 0, "num_steps": 1},
+        "data": {
+            "train": {
+                "name": "synthetic_classification",
+                "n": 64,
+                "num_classes": 4,
+                "dim": 8,
+                "batch_size": 32,
+            }
+        },
+    }
+    tr = Trainer(cfg)
+    stats = tr.fit()
+    assert np.isfinite(stats["train/loss"])
+    assert any(p.is_file() for p in (tmp_path / "prof").rglob("*"))

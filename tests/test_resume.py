@@ -98,3 +98,26 @@ def test_resume_disabled_restarts_from_scratch(tmp_db, tmp_path):
     series = store.metric_series(rows["train"]["id"], "train/loss")
     assert [s for s, _ in series] == [0]
     store.close()
+
+
+def test_independent_runs_do_not_collide_in_storage(tmp_path):
+    """Two separate submissions (fresh DBs, same project/task names, same
+    storage root) must not resume each other's checkpoints — the second
+    run here has a different model width and would crash on restore."""
+    import copy
+
+    cfg = _dag(tmp_path, epochs=1)
+    cfg = copy.deepcopy(cfg)
+    del cfg["executors"]["train"]["args"]["dag_name"]  # default namespace
+    statuses = run_dag_local(
+        cfg, db_path=str(tmp_path / "a.sqlite"), workdir=str(tmp_path)
+    )
+    assert all(s.value == "success" for s in statuses.values())
+
+    cfg2 = copy.deepcopy(cfg)
+    model = cfg2["executors"]["train"]["args"]["model"]
+    model["hidden"] = [h * 2 for h in model["hidden"]]
+    statuses = run_dag_local(
+        cfg2, db_path=str(tmp_path / "b.sqlite"), workdir=str(tmp_path)
+    )
+    assert all(s.value == "success" for s in statuses.values())
