@@ -19,7 +19,7 @@ def load_all() -> None:
 
     import importlib
 
-    for mod in ("resnet", "unet", "bert", "transformer", "moe", "vit"):
+    for mod in ("resnet", "unet", "bert", "transformer", "moe", "vit", "pipeline_lm"):
         name = f"mlcomp_tpu.models.{mod}"
         try:
             importlib.import_module(name)

@@ -1,0 +1,166 @@
+"""Pipeline-parallel decoder LM: depth sharded over the ``pp`` mesh axis.
+
+``transformer_lm`` replicates (or tensor/sequence-shards) every layer on
+every device; this family instead gives each pp device ``layers/pp``
+decoder layers and rotates activations through the ring
+(parallel/pipeline.py — GPipe when ``layers == pp``, the interleaved
+circular schedule with a ``v``× smaller bubble when ``layers = v*pp``).
+No upstream analog: the reference scales by DDP replication only.
+
+Design notes (TPU-first):
+
+- decoder-layer weights live in STACKED params (leading axis = layers),
+  sharded ``P("pp")`` by the rule pass in parallel/sharding.py — each
+  device holds exactly its slices, so model depth scales with the pp
+  axis while per-device HBM stays flat;
+- embed / final-norm / lm_head compute replicated on every device — tiny
+  next to the trunk, and keeping them SPMD avoids special first/last
+  stages;
+- data parallelism composes: the batch stays sharded over (dp, fsdp)
+  inside the pipeline (``data_axes``), activations never cross data axes;
+- on a mesh without a pp axis (tests, single chip) the same stacked
+  params run through a sequential ``lax.scan`` — one parameter layout,
+  two execution schedules, and the scan path doubles as the numerics
+  reference for the pipelined one;
+- params stay NETWORK-ordered so checkpoints are portable across mesh
+  shapes (device order would bake in one pp size).  The price: the
+  interleaved configs (``layers > pp``) pay a per-step weight
+  permutation across pp shards inside ``pipeline_apply``; ``layers ==
+  pp`` (plain GPipe) is permutation-free.  A fixed-stage device-ordered
+  layout (``pre_interleaved=True``) is the future optimization if the
+  trunk-weight traffic ever dominates.
+
+The per-layer math mirrors models/transformer.py's DecoderLayer (RMSNorm
+pre-norm, RoPE, GQA attention, SwiGLU) in functional form, so parity
+tests can compare against the sequential model family directly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.models import MODELS
+from mlcomp_tpu.models.transformer import apply_rope
+from mlcomp_tpu.ops.attention import dot_product_attention
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * scale).astype(dtype)
+
+
+def _decoder_stage(params, h, *, heads: int, kv_heads: int, dtype) -> jax.Array:
+    """One decoder layer on (mbs, S, hidden) activations; params is one
+    stage's slice of the stacked weights."""
+    mbs, s, hidden = h.shape
+    d_head = hidden // heads
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (mbs, s))
+
+    x = _rmsnorm(h, params["ln1"], dtype)
+    q = (x @ params["q"].astype(dtype)).reshape(mbs, s, heads, d_head)
+    k = (x @ params["k"].astype(dtype)).reshape(mbs, s, kv_heads, d_head)
+    v = (x @ params["v"].astype(dtype)).reshape(mbs, s, kv_heads, d_head)
+    q = apply_rope(q, positions)
+    k = apply_rope(k, positions)
+    attn = dot_product_attention(q, k, v, causal=True)
+    h = h + attn.reshape(mbs, s, heads * d_head) @ params["out"].astype(dtype)
+
+    x = _rmsnorm(h, params["ln2"], dtype)
+    g = nn.silu(x @ params["gate"].astype(dtype)) * (x @ params["up"].astype(dtype))
+    return h + g @ params["down"].astype(dtype)
+
+
+@MODELS.register("transformer_lm_pp")
+class PipelinedTransformerLM(nn.Module):
+    vocab_size: int = 32000
+    hidden: int = 512
+    layers: int = 8
+    heads: int = 8
+    kv_heads: Optional[int] = None
+    mlp_dim: Optional[int] = None
+    dtype: str = "bfloat16"
+    # microbatches per pipeline pass; 0 = the pp axis size (minimum that
+    # fills the ring).  More microbatches shrink the relative bubble.
+    n_microbatches: int = 0
+    remat: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from mlcomp_tpu.parallel.mesh import axis_size, current_mesh
+        from mlcomp_tpu.parallel.pipeline import pipeline_apply
+
+        dtype = jnp.dtype(self.dtype)
+        ids = x.astype(jnp.int32)
+        kv_heads = self.kv_heads or self.heads
+        mlp_dim = self.mlp_dim or self.hidden * 4
+        d_head = self.hidden // self.heads
+
+        init = nn.initializers.lecun_normal()
+        ones = nn.initializers.ones
+
+        def stacked(name, *shape, w_init=init):
+            return self.param(name, w_init, (self.layers, *shape), jnp.float32)
+
+        stages = {
+            "ln1": stacked("stages_ln1", self.hidden, w_init=ones),
+            "q": stacked("stages_q", self.hidden, self.heads * d_head),
+            "k": stacked("stages_k", self.hidden, kv_heads * d_head),
+            "v": stacked("stages_v", self.hidden, kv_heads * d_head),
+            "out": stacked("stages_out", self.heads * d_head, self.hidden),
+            "ln2": stacked("stages_ln2", self.hidden, w_init=ones),
+            "gate": stacked("stages_gate", self.hidden, mlp_dim),
+            "up": stacked("stages_up", self.hidden, mlp_dim),
+            "down": stacked("stages_down", mlp_dim, self.hidden),
+        }
+        stage_fn = partial(
+            _decoder_stage, heads=self.heads, kv_heads=kv_heads, dtype=dtype
+        )
+
+        h = nn.Embed(self.vocab_size, self.hidden, dtype=dtype, name="emb")(ids)
+
+        mesh = current_mesh()
+        pp = axis_size(mesh, "pp")
+        if pp > 1 and self.layers % pp:
+            raise ValueError(f"{self.layers} layers not a multiple of pp={pp}")
+        # init traces with a 1-row sample batch that can't be microbatched;
+        # the scan path creates identical param shapes
+        if pp > 1 and not self.is_initializing():
+            n_micro = self.n_microbatches or pp
+            b = h.shape[0]
+            dp = axis_size(mesh, "dp") * axis_size(mesh, "fsdp")
+            if b % n_micro or (b // n_micro) % dp:
+                raise ValueError(
+                    f"batch {b} must split into n_microbatches={n_micro} "
+                    f"microbatches each divisible by dp×fsdp={dp}; adjust "
+                    "batch_size or the model's n_microbatches (the loader "
+                    "pads ragged tails, so every Trainer batch is full-size)"
+                )
+            h = pipeline_apply(
+                stage_fn,
+                stages,
+                h,
+                n_micro,
+                mesh,
+                remat=self.remat,
+                data_axes=("dp", "fsdp"),
+            )
+        else:
+            # no pp axis: run the same stacked params sequentially — the
+            # schedule-free reference path (tests compare against this)
+            body = jax.checkpoint(stage_fn) if self.remat else stage_fn
+            h, _ = jax.lax.scan(
+                lambda carry, p: (body(p, carry), None), h, stages
+            )
+
+        h = _rmsnorm(
+            h, self.param("final_norm", ones, (self.hidden,), jnp.float32), dtype
+        )
+        return nn.Dense(
+            self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(h)

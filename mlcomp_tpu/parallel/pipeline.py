@@ -128,6 +128,7 @@ def pipeline_apply(
     axis_name: str = "pp",
     remat: bool = True,
     pre_interleaved: bool = False,
+    data_axes: tuple = (),
 ) -> jax.Array:
     """Run ``x`` through ``V`` pipelined virtual stages on ``n_stages`` devices.
 
@@ -141,6 +142,11 @@ def pipeline_apply(
     - ``x``: (B, ...) global batch; B must divide into ``n_microbatches``.
       With ``v > 1`` pick ``n_microbatches`` a multiple of ``n_stages``
       (other values stay correct but waste injection slots on bubble junk).
+    - ``data_axes``: mesh axes the per-microbatch batch dimension is
+      sharded over (e.g. ``("dp", "fsdp")``) — composes data parallelism
+      with the pipeline: each dp group runs the same schedule on its own
+      batch shard and activations never cross data axes.  Empty = batch
+      replicated (the standalone/test case).
 
     Returns (B, ...) outputs after the last stage.
     """
@@ -176,8 +182,8 @@ def pipeline_apply(
             n_virtual=n_virtual,
         ),
         mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(axis_name),
+        in_specs=(P(axis_name), P(None, data_axes) if data_axes else P()),
+        out_specs=P(axis_name, None, data_axes) if data_axes else P(axis_name),
     )
     stacked = run(stacked_params, mb)        # (n_stages, M, mbs, ...)
     out = stacked[-1]                        # last stage's banked outputs

@@ -45,6 +45,9 @@ TP_RULES: List[Tuple[str, Tuple[Tuple[int, str], ...]]] = [
     (r"(^|/)experts_w1$", ((0, "ep"), (-1, "tp"))),
     (r"(^|/)experts_w2$", ((0, "ep"), (-2, "tp"))),
     (r"(^|/)router/kernel$", ()),               # tiny; keep replicated
+    # pipelined LM stacked stage params (V, ...): one virtual-stage slice
+    # per pp device (models/pipeline_lm.py)
+    (r"(^|/)stages_[^/]+$", ((0, "pp"),)),
 ]
 
 

@@ -288,14 +288,17 @@ class Trainer:
             if batch is None:
                 break
             if self.profiler:
-                self.profiler.step(global_step + n)
+                # pending=state: barrier before a trace stop so async
+                # dispatch can't truncate the profiled window
+                self.profiler.step(global_step + n, pending=self.state.params)
             with tracer.span("step", n=n):
                 self.state, stats = self._train_step(self.state, batch)
             for k, v in stats.items():
                 agg[k] = agg.get(k, 0.0) + v  # device-side accumulation
             n += 1
         if self.profiler:
-            self.profiler.flush()  # stop-only: eval work stays out of the trace
+            # stop-only: eval work stays out of the trace
+            self.profiler.flush(pending=self.state.params)
         return {k: float(v) / max(n, 1) for k, v in agg.items()}
 
     def eval_epoch(self, split: str = "valid") -> Dict[str, float]:

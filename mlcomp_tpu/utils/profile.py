@@ -38,7 +38,11 @@ class StepProfiler:
         self._active = False
         self._done = False
 
-    def step(self, global_step: int) -> None:
+    def step(self, global_step: int, pending=None) -> None:
+        """``pending``: arrays (e.g. the train state) to block on before a
+        stop — dispatch is async, so without the barrier the device would
+        still be executing the profiled steps when the trace closes and
+        the window would capture little device activity."""
         import jax
 
         if not self._done and not self._active and (
@@ -47,14 +51,20 @@ class StepProfiler:
             jax.profiler.start_trace(self.dir)
             self._active = True
         elif self._active and global_step >= self.stop_step:
+            if pending is not None:
+                jax.block_until_ready(pending)
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
 
-    def flush(self) -> None:
+    def flush(self, pending=None) -> None:
         """Stop-only boundary (end of epoch): closes a window that is
         mid-capture so eval/checkpoint work never pollutes the trace, and
         never starts a new one."""
+        if self._active and pending is not None:
+            import jax
+
+            jax.block_until_ready(pending)
         self.close()
 
     def close(self) -> None:

@@ -1,0 +1,128 @@
+"""Pipelined decoder LM: schedule parity, sharding, Trainer integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlcomp_tpu.models import create_model
+from mlcomp_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    set_current_mesh,
+)
+
+
+def _model(**over):
+    cfg = {
+        "name": "transformer_lm_pp",
+        "vocab_size": 64,
+        "hidden": 32,
+        "layers": 8,
+        "heads": 4,
+        "kv_heads": 2,
+        "mlp_dim": 64,
+        "dtype": "float32",
+    }
+    cfg.update(over)
+    return create_model(cfg)
+
+
+def test_pipelined_matches_sequential_schedule():
+    """Same params through the pp=4 ring == the scan reference path."""
+    model = _model()
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 16)), jnp.int32)
+
+    seq_mesh = make_mesh(MeshSpec(dp=8))
+    set_current_mesh(seq_mesh)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    ref = jax.jit(model.apply)(variables, ids)
+
+    pp_mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    set_current_mesh(pp_mesh)
+    try:
+        v = jax.device_put(variables, replicated(pp_mesh))
+        x = jax.device_put(ids, batch_sharding(pp_mesh))
+        out = jax.jit(model.apply)(v, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4
+        )
+    finally:
+        set_current_mesh(None)
+
+
+def test_pipelined_interleaved_layers_match():
+    """layers=8 on pp=4 → v=2 interleaved laps; numerics must hold."""
+    model = _model(layers=8, n_microbatches=4)
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 64, (8, 8)), jnp.int32)
+    seq_mesh = make_mesh(MeshSpec(dp=8))
+    set_current_mesh(seq_mesh)
+    variables = model.init(jax.random.PRNGKey(1), ids)
+    ref = jax.jit(model.apply)(variables, ids)
+    pp_mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    set_current_mesh(pp_mesh)
+    try:
+        out = jax.jit(model.apply)(
+            jax.device_put(variables, replicated(pp_mesh)),
+            jax.device_put(ids, batch_sharding(pp_mesh)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4
+        )
+    finally:
+        set_current_mesh(None)
+
+
+def test_trainer_trains_pipelined_lm():
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {
+            "name": "transformer_lm_pp",
+            "vocab_size": 64,
+            "hidden": 32,
+            "layers": 4,
+            "heads": 4,
+            "mlp_dim": 64,
+            "dtype": "float32",
+        },
+        "optimizer": {"name": "adam", "lr": 1e-3},
+        "loss": "lm_cross_entropy",
+        "metrics": [],
+        "epochs": 1,
+        "seed": 0,
+        "mesh": {"dp": 2, "pp": 4},
+        "data": {
+            "train": {
+                "name": "synthetic_tokens",
+                "n": 16,
+                "seq_len": 16,
+                "vocab_size": 64,
+                "batch_size": 8,
+            }
+        },
+    }
+    tr = Trainer(cfg)
+    # stacked stage weights must be sharded over pp
+    q = tr.state.params["stages_q"]
+    assert q.shape[0] == 4
+    assert "pp" in jax.tree.leaves(q.sharding.spec)[0:1] or "pp" in q.sharding.spec
+    first = tr.train_epoch()
+    assert np.isfinite(first["loss"])
+    second = tr.train_epoch()
+    assert second["loss"] < first["loss"]  # it actually learns
+    set_current_mesh(None)
+
+
+def test_pipelined_rejects_indivisible_layers():
+    model = _model(layers=6)
+    ids = jnp.zeros((4, 8), jnp.int32)
+    mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    set_current_mesh(mesh)
+    try:
+        with pytest.raises(ValueError, match="not a multiple"):
+            model.init(jax.random.PRNGKey(0), ids)
+    finally:
+        set_current_mesh(None)
