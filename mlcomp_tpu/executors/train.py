@@ -103,7 +103,16 @@ class TrainExecutor(Executor):
                 )
                 trainer.epochs = trainer.epochs_done  # fit() runs nothing
 
+        # async epoch checkpoints: the device snapshot happens before
+        # save() returns (donation-safe), the disk write overlaps the next
+        # epoch; closed before any latest_step/restore on these dirs
+        from mlcomp_tpu.io.checkpoint import AsyncCheckpointWriter
+
+        writer = AsyncCheckpointWriter(ckpt_dir)
+        best_writer: Optional[AsyncCheckpointWriter] = None
+
         def on_epoch(epoch: int, stats: Dict[str, float]) -> None:
+            nonlocal best_writer
             for k, v in stats.items():
                 ctx.metric(k, v, step=epoch)
             ctx.log(
@@ -111,7 +120,7 @@ class TrainExecutor(Executor):
                 + " ".join(f"{k}={v:.4f}" for k, v in sorted(stats.items()))
             )
             if (epoch + 1) % int(cfg.get("ckpt_every", 1)) == 0:
-                save_checkpoint(ckpt_dir, trainer.state, step=int(trainer.state.step))
+                writer.save(trainer.state, step=int(trainer.state.step))
             if best_metric and best_metric not in stats:
                 if not _warned_missing[0]:
                     _warned_missing[0] = True
@@ -129,15 +138,20 @@ class TrainExecutor(Executor):
                     best.update(
                         value=v, epoch=epoch, step=int(trainer.state.step)
                     )
-                    save_checkpoint(
-                        best_dir, trainer.state, step=int(trainer.state.step)
-                    )
+                    if best_writer is None:
+                        best_writer = AsyncCheckpointWriter(best_dir)
+                    best_writer.save(trainer.state, step=int(trainer.state.step))
                     ctx.log(
                         f"new best {best_metric}={v:.4f} @ epoch {epoch}"
                         f" -> {best_dir}"
                     )
 
-        final = trainer.fit(on_epoch=on_epoch)
+        try:
+            final = trainer.fit(on_epoch=on_epoch)
+        finally:
+            writer.close()
+            if best_writer is not None:
+                best_writer.close()
         if trainer.stopped_early is not None:
             ctx.log(f"early stop at epoch {trainer.stopped_early}")
         if trainer.trace_path:

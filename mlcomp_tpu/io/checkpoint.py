@@ -16,13 +16,51 @@ import jax
 import orbax.checkpoint as ocp
 
 
-def _mgr(directory: Path, max_to_keep: int = 3) -> ocp.CheckpointManager:
+def _mgr(
+    directory: Path, max_to_keep: int = 3, async_save: bool = False
+) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
         directory,
         options=ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True
+            max_to_keep=max_to_keep,
+            create=True,
+            enable_async_checkpointing=async_save,
         ),
     )
+
+
+class AsyncCheckpointWriter:
+    """Long-lived manager whose saves overlap training.
+
+    ``save_checkpoint`` opens a manager, writes, and blocks per call —
+    right for one-shot saves.  The epoch loop wants the opposite: orbax's
+    async path snapshots device arrays to host memory before returning
+    (donation-safe — the next train step may overwrite the HBM buffers
+    immediately) and streams to disk on a background thread, so epoch
+    k+1 computes while epoch k's checkpoint lands.  ``wait()`` joins
+    outstanding writes; ALWAYS ``close()`` before reading
+    ``latest_step``/``restore_checkpoint`` on the same directory.
+    """
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+        self.directory = Path(directory).absolute()
+        self._mgr = _mgr(self.directory, max_to_keep, async_save=True)
+
+    def save(self, state: Any, step: int) -> None:
+        self._mgr.save(int(step), args=ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mgr.close()
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def save_checkpoint(

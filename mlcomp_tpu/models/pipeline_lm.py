@@ -45,14 +45,8 @@ import jax
 import jax.numpy as jnp
 
 from mlcomp_tpu.models import MODELS
-from mlcomp_tpu.models.transformer import apply_rope
+from mlcomp_tpu.models.transformer import apply_rope, rmsnorm as _rmsnorm
 from mlcomp_tpu.ops.attention import dot_product_attention
-
-
-def _rmsnorm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
-    x32 = x.astype(jnp.float32)
-    x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
-    return (x32 * scale).astype(dtype)
 
 
 def _decoder_stage(params, h, *, heads: int, kv_heads: int, dtype) -> jax.Array:

@@ -37,15 +37,21 @@ def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax
     return out.astype(x.dtype)
 
 
+def rmsnorm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Functional RMSNorm core (fp32 accumulation) — shared by the module
+    below and the stacked-params pipelined LM so the math can't drift."""
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * scale).astype(dtype)
+
+
 class RMSNorm(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
-        x32 = x.astype(jnp.float32)
-        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
-        return (x32 * scale).astype(self.dtype)
+        return rmsnorm(x, scale, self.dtype)
 
 
 class SelfAttention(nn.Module):

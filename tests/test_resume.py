@@ -121,3 +121,28 @@ def test_independent_runs_do_not_collide_in_storage(tmp_path):
         cfg2, db_path=str(tmp_path / "b.sqlite"), workdir=str(tmp_path)
     )
     assert all(s.value == "success" for s in statuses.values())
+
+
+def test_async_writer_overlapped_saves(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlcomp_tpu.io.checkpoint import (
+        AsyncCheckpointWriter,
+        latest_step,
+        restore_checkpoint,
+    )
+
+    tree = {"w": jnp.arange(8.0), "step": jnp.zeros(())}
+    with AsyncCheckpointWriter(tmp_path / "ck", max_to_keep=2) as w:
+        for step in range(5):
+            w.save(jax.tree.map(lambda x: x + step, tree), step=step)
+    assert latest_step(tmp_path / "ck") == 4
+    restored = restore_checkpoint(tmp_path / "ck", tree)
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(8.0) + 4)
+    # retention honors max_to_keep across async saves
+    kept = sorted(
+        int(p.name) for p in (tmp_path / "ck").iterdir() if p.name.isdigit()
+    )
+    assert len(kept) <= 2 and kept[-1] == 4
