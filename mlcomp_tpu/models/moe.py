@@ -56,6 +56,42 @@ class MoEBlock(nn.Module):
         )
         probs = jax.nn.softmax(logits, axis=-1)                      # (T, E)
 
+        w1 = self.param(
+            "experts_w1",
+            nn.initializers.normal(0.02),
+            (e, d, self.d_ff),
+            jnp.float32,
+        ).astype(self.dtype)
+        w2 = self.param(
+            "experts_w2",
+            nn.initializers.normal(0.02),
+            (e, self.d_ff, d),
+            jnp.float32,
+        ).astype(self.dtype)
+
+        if not train:
+            # Inference is DROP-FREE: capacity competition exists for
+            # training throughput, but its drop pattern depends on the
+            # token count — a single-token decode step (T = B) and the
+            # same token inside a full forward (T = B*S) would drop
+            # differently, so KV-cache generation could diverge from the
+            # full forward.  Dense routing (every expert on every token,
+            # top-k combine) restores exact equivalence; at decode shapes
+            # the FFN is tiny, and eval pays e/k× FFN FLOPs for
+            # determinism.
+            topv, topi = jax.lax.top_k(probs, self.k)                # (T, k)
+            gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+            h_all = jax.nn.gelu(
+                jnp.einsum("td,edf->tef", tokens.astype(self.dtype), w1)
+            )
+            out_all = jnp.einsum("tef,efd->ted", h_all, w2)          # (T, E, d)
+            weight = (
+                jax.nn.one_hot(topi, e, dtype=jnp.float32)
+                * gates[..., None]
+            ).sum(1)                                                 # (T, E)
+            out = jnp.einsum("te,ted->td", weight.astype(self.dtype), out_all)
+            return out.reshape(b, s, d)
+
         # top-k dispatch with per-expert positions under a fixed capacity:
         # round r assigns every token its r-th-best expert; a token's slot is
         # (# earlier tokens routed to that expert, across all rounds so far)
@@ -92,19 +128,6 @@ class MoEBlock(nn.Module):
         aux = self.aux_weight * e * jnp.sum(me * ce)
         self.sow("losses", "moe_aux", aux)
 
-        w1 = self.param(
-            "experts_w1",
-            nn.initializers.normal(0.02),
-            (e, d, self.d_ff),
-            jnp.float32,
-        ).astype(self.dtype)
-        w2 = self.param(
-            "experts_w2",
-            nn.initializers.normal(0.02),
-            (e, self.d_ff, d),
-            jnp.float32,
-        ).astype(self.dtype)
-
         expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(self.dtype))
         h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
         expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
@@ -128,13 +151,16 @@ class MoELayer(nn.Module):
     seq_parallel: "bool | str" = False
 
     @nn.compact
-    def __call__(self, x, positions, train: bool = False):
+    def __call__(
+        self, x, positions, train: bool = False, decode: bool = False,
+        kv_mask=None,
+    ):
         from mlcomp_tpu.models.transformer import SelfAttention
 
         x = SelfAttention(
             self.hidden, self.heads, self.kv_heads, self.dtype,
             seq_parallel=self.seq_parallel, name="attn",
-        )(x, positions)
+        )(x, positions, decode=decode, kv_mask=kv_mask)
         h = RMSNorm(self.dtype)(x)
         return x + MoEBlock(
             n_experts=self.n_experts,
@@ -165,11 +191,22 @@ class MoELM(nn.Module):
     seq_parallel: "bool | str" = False
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(
+        self,
+        x,
+        train: bool = False,
+        decode: bool = False,
+        positions=None,
+        kv_mask=None,
+    ):
+        """``decode=True`` runs incremental decoding against the "cache"
+        collection (see models/generation.py); the MoE FFN is stateless
+        per token, so only the attention layers carry cache state."""
+        from mlcomp_tpu.models.transformer import resolve_positions
+
         dtype = jnp.dtype(self.dtype)
         ids = x.astype(jnp.int32)
-        b, s = ids.shape
-        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        positions = resolve_positions(ids, decode, positions)
         kv_heads = self.kv_heads or self.heads
         d_ff = self.d_ff or self.hidden * 4
 
@@ -180,12 +217,12 @@ class MoELM(nn.Module):
                     self.hidden, self.heads, kv_heads, self.n_experts, d_ff,
                     self.k, self.capacity_factor, dtype,
                     seq_parallel=self.seq_parallel,
-                )(h, positions, train=train)
+                )(h, positions, train=train, decode=decode, kv_mask=kv_mask)
             else:
                 h = DecoderLayer(
                     self.hidden, self.heads, kv_heads, d_ff, dtype,
                     seq_parallel=self.seq_parallel,
-                )(h, positions)
+                )(h, positions, decode=decode, kv_mask=kv_mask)
         h = RMSNorm(dtype)(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(h)

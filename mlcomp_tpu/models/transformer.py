@@ -37,6 +37,23 @@ def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax
     return out.astype(x.dtype)
 
 
+def resolve_positions(ids: jax.Array, decode: bool, positions):
+    """Decode-contract helper shared by the decoder LM families: explicit
+    positions are required in decode mode (the caller owns the decode
+    cursor — see models/generation.py); otherwise default to 0..S-1."""
+    if decode:
+        if positions is None:
+            raise ValueError(
+                "decode=True needs explicit positions (the caller owns "
+                "the decode cursor; see models/generation.py)"
+            )
+        return positions
+    if positions is None:
+        b, s = ids.shape
+        return jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    return positions
+
+
 def rmsnorm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     """Functional RMSNorm core (fp32 accumulation) — shared by the module
     below and the stacked-params pipelined LM so the math can't drift."""
@@ -145,6 +162,17 @@ class SelfAttention(nn.Module):
         mask = (slots[None, :] <= q_slots[:, None])[None, None]  # (1,1,S,max)
         if kv_mask is not None:
             mask = mask & kv_mask[:, None, None, :].astype(jnp.bool_)
+        if s > 1 and kv_mask is None:
+            # prefill fast path: when the cache is still empty, attention
+            # over the full buffer under the slot mask equals plain causal
+            # attention over just the new K/V — which takes the flash
+            # kernel (dense masks don't).  lax.cond keeps chunked prefill
+            # (i > 0) on the general path.
+            return jax.lax.cond(
+                i == 0,
+                lambda: dot_product_attention(q, k, v, causal=True),
+                lambda: dot_product_attention(q, k_all, v_all, mask=mask),
+            )
         return dot_product_attention(q, k_all, v_all, mask=mask)
 
 
@@ -196,15 +224,7 @@ class TransformerLM(nn.Module):
         (left-pad) cache slots."""
         dtype = jnp.dtype(self.dtype)
         ids = x.astype(jnp.int32)
-        b, s = ids.shape
-        if decode:
-            if positions is None:
-                raise ValueError(
-                    "decode=True needs explicit positions (the caller owns "
-                    "the decode cursor; see models/generation.py)"
-                )
-        elif positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        positions = resolve_positions(ids, decode, positions)
         kv_heads = self.kv_heads or self.heads
         mlp_dim = self.mlp_dim or self.hidden * 4
 

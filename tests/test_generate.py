@@ -130,6 +130,63 @@ def test_sample_token_greedy_is_argmax():
     np.testing.assert_array_equal(np.asarray(tok), [1, 0])
 
 
+@pytest.mark.parametrize("seed", [3, 7])
+def test_moe_lm_generates_with_cache(seed):
+    """MoE decoder: cache decode == full-forward greedy decoding.
+
+    Seed 7 historically made both batch rows route to the same expert in
+    a decode step — under capacity routing the second row's expert output
+    was dropped and generation diverged from the full forward.  Inference
+    routing is now dense (drop-free), so equality must hold for ANY seed.
+    """
+    model = create_model(
+        {
+            "name": "moe_lm",
+            "vocab_size": 64,
+            "hidden": 32,
+            "layers": 2,
+            "heads": 4,
+            "n_experts": 4,
+            "d_ff": 64,
+            "moe_every": 2,
+            "dtype": "float32",
+        }
+    )
+    prompt = jnp.asarray(
+        np.random.RandomState(seed).randint(1, 64, size=(2, 5)), jnp.int32
+    )
+    variables = {
+        "params": model.init(jax.random.PRNGKey(seed), prompt)["params"]
+    }
+    out = generate(model, variables, prompt, 5)
+    ref = _greedy_no_cache(model, variables, prompt, 5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_chunked_prefill_matches_full(lm):
+    """Two decode calls with s>1 (chunked prefill) == one full forward;
+    exercises the i>0 branch of the prefill cond."""
+    model, variables, _ = lm
+    ids = jnp.asarray(np.random.RandomState(4).randint(1, 64, (2, 8)), jnp.int32)
+    from mlcomp_tpu.models.generation import init_cache
+
+    cache = init_cache(model, 2, 8)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32)[None], (2, 8))
+    logits_a, upd = model.apply(
+        {**variables, "cache": cache}, ids[:, :5], decode=True,
+        positions=pos[:, :5], mutable=["cache"],
+    )
+    logits_b, _ = model.apply(
+        {**variables, "cache": upd["cache"]}, ids[:, 5:], decode=True,
+        positions=pos[:, 5:], mutable=["cache"],
+    )
+    chunked = jnp.concatenate([logits_a, logits_b], axis=1)
+    full = model.apply(variables, ids)
+    np.testing.assert_allclose(
+        np.asarray(chunked), np.asarray(full), atol=1e-4, rtol=1e-4
+    )
+
+
 def test_generate_executor_writes_ids(tmp_path):
     from mlcomp_tpu.executors import load_all
     from mlcomp_tpu.executors.base import ExecutionContext, create_executor

@@ -14,7 +14,7 @@ def test_moe_block_routes_and_sows_aux():
     x = jnp.asarray(np.random.RandomState(0).normal(size=(2, 8, 16)), jnp.float32)
     variables = dict(block.init(jax.random.PRNGKey(0), x))
     variables.pop("losses", None)  # same as train.state.init_model
-    out, state = block.apply(variables, x, mutable=["losses"])
+    out, state = block.apply(variables, x, train=True, mutable=["losses"])
     assert out.shape == x.shape
     aux = jax.tree.leaves(state["losses"])
     assert len(aux) == 1 and np.isfinite(float(aux[0]))
@@ -23,14 +23,20 @@ def test_moe_block_routes_and_sows_aux():
 
 
 def test_moe_capacity_drops_tokens():
-    # capacity 1 slot/expert: most tokens dropped -> output rows mostly zero
+    # TRAINING with capacity 1 slot/expert: most tokens dropped -> output
+    # rows mostly zero.  INFERENCE routes densely: same block, same tiny
+    # capacity factor, but no token may be dropped (KV-cache decode parity
+    # depends on this).
     block = MoEBlock(n_experts=2, d_model=8, d_ff=16, k=1,
                      capacity_factor=0.1, dtype=jnp.float32)
     x = jnp.asarray(np.random.RandomState(1).normal(size=(1, 32, 8)), jnp.float32)
     variables = block.init(jax.random.PRNGKey(0), x)
-    out, _ = block.apply(variables, x, mutable=["losses"])
+    out, _ = block.apply(variables, x, train=True, mutable=["losses"])
     row_norms = np.asarray(jnp.linalg.norm(out[0], axis=-1))
     assert (row_norms < 1e-6).sum() >= 28  # ~2 slots of 32 survive
+    dense = block.apply(variables, x, train=False)
+    dense_norms = np.asarray(jnp.linalg.norm(dense[0], axis=-1))
+    assert (dense_norms > 1e-6).all()  # drop-free at inference
 
 
 def test_moe_lm_forward():
