@@ -81,15 +81,25 @@ class MoEBlock(nn.Module):
             # determinism.
             topv, topi = jax.lax.top_k(probs, self.k)                # (T, k)
             gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
-            h_all = jax.nn.gelu(
-                jnp.einsum("td,edf->tef", tokens.astype(self.dtype), w1)
-            )
-            out_all = jnp.einsum("tef,efd->ted", h_all, w2)          # (T, E, d)
             weight = (
                 jax.nn.one_hot(topi, e, dtype=jnp.float32)
                 * gates[..., None]
             ).sum(1)                                                 # (T, E)
-            out = jnp.einsum("te,ted->td", weight.astype(self.dtype), out_all)
+            toks = tokens.astype(self.dtype)
+
+            # scan one expert at a time: peak intermediate is (T, d_ff),
+            # not (T, E, d_ff) — dense routing must not spike eval memory
+            # E× past what a training step uses
+            def one_expert(acc, wse):
+                w1_e, w2_e, we = wse
+                h_e = jax.nn.gelu(toks @ w1_e)                       # (T, F)
+                return acc + we[:, None].astype(self.dtype) * (h_e @ w2_e), None
+
+            out, _ = jax.lax.scan(
+                one_expert,
+                jnp.zeros((t, d), self.dtype),
+                (w1, w2, weight.T),
+            )
             return out.reshape(b, s, d)
 
         # top-k dispatch with per-expert positions under a fixed capacity:

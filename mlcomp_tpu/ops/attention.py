@@ -37,10 +37,27 @@ def reference_attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_start: Optional[jax.Array] = None,
+    kv_stop: Optional[jax.Array] = None,
 ) -> jax.Array:
     """XLA path. q: (B, Sq, H, D); k,v: (B, Sk, Hkv, D) with Hkv | H (GQA —
     shared KV heads are broadcast, never materialized); mask broadcastable
-    to (B, {1|Hkv}, Sq, Sk) (or (B, H, Sq, Sk) when Hkv == H)."""
+    to (B, {1|Hkv}, Sq, Sk) (or (B, H, Sq, Sk) when Hkv == H);
+    ``kv_start``/``kv_stop``: (B,) per-row valid-key windows (see
+    flash_attention), folded into the mask here."""
+    if kv_start is not None or kv_stop is not None:
+        s_k, nb = k.shape[1], k.shape[0]
+        cols = jnp.arange(s_k, dtype=jnp.int32)[None]
+        lo = (
+            jnp.zeros((nb, 1), jnp.int32) if kv_start is None
+            else kv_start.astype(jnp.int32)[:, None]
+        )
+        hi = (
+            jnp.full((nb, 1), s_k, jnp.int32) if kv_stop is None
+            else kv_stop.astype(jnp.int32)[:, None]
+        )
+        window = ((cols >= lo) & (cols < hi))[:, None, None, :]  # (B,1,1,Sk)
+        mask = window if mask is None else (mask.astype(jnp.bool_) & window)
     b, s_q, h, d = q.shape
     h_kv = k.shape[2]
     if h % h_kv:
@@ -77,19 +94,25 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_start: Optional[jax.Array] = None,
+    kv_stop: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-head attention over (B, S, H, D) tensors.
 
     ``mask``: True = attend, broadcastable to (B, H, Sq, Sk).
     ``causal``: apply a causal triangle (decoder LM).
+    ``kv_start``/``kv_stop``: (B,) per-row valid-key windows — the
+    kernel-friendly form of key-padding masks (right padding: stop =
+    lengths; left padding: start = pad counts).  Unlike a dense mask,
+    these keep the flash-kernel path.
     """
     raw = os.environ.get("MLCOMP_TPU_FLASH", "auto").strip().lower()
     forced = raw in ("1", "true", "on", "yes")
     disabled = raw in ("0", "false", "off", "no")
     if not disabled and (forced or _on_tpu()):
         if mask is not None:
-            # the kernel covers causal/full; arbitrary dense masks stay on
-            # the XLA path (key-padding masks: see flash_attention kv_len)
+            # the kernel covers causal/full/kv-window; arbitrary dense
+            # masks stay on the XLA path (key padding: use kv_start/stop)
             if forced:
                 warnings.warn(
                     "MLCOMP_TPU_FLASH forced on but a dense mask was passed; "
@@ -100,7 +123,10 @@ def dot_product_attention(
             try:
                 from mlcomp_tpu.ops.pallas.flash_attention import flash_attention
 
-                return flash_attention(q, k, v, causal=causal, scale=scale)
+                return flash_attention(
+                    q, k, v, causal=causal, scale=scale,
+                    kv_start=kv_start, kv_stop=kv_stop,
+                )
             except (ImportError, NotImplementedError) as e:
                 if forced:  # explicit request must not fail silently
                     warnings.warn(
@@ -109,4 +135,7 @@ def dot_product_attention(
                         f"reference path",
                         stacklevel=2,
                     )
-    return reference_attention(q, k, v, mask=mask, causal=causal, scale=scale)
+    return reference_attention(
+        q, k, v, mask=mask, causal=causal, scale=scale,
+        kv_start=kv_start, kv_stop=kv_stop,
+    )

@@ -57,15 +57,71 @@ def _causal_mask(s, i, j, block_q, block_kv):
     return jnp.where(rows >= cols, s, NEG_INF)
 
 
+def _bounds_mask(s, j, block_kv, lo, hi):
+    """Mask key columns outside this batch row's valid [lo, hi) window."""
+    cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where((cols >= lo) & (cols < hi), s, NEG_INF)
+
+
+def _block_live(causal, i, j, block_q, block_kv, lo, hi):
+    """Static causal skip + dynamic skip of blocks fully outside [lo, hi)."""
+    live = (not causal) or (j * block_kv <= i * block_q + block_q - 1)
+    if lo is None:
+        return live
+    return jnp.logical_and(
+        live, (j * block_kv < hi) & ((j + 1) * block_kv > lo)
+    )
+
+
+def _maybe_bounded_call(
+    kernel, grid, in_specs, out_specs, out_shape, scratch, interpret,
+    bounds, operands,
+):
+    """pallas_call with KV-bound scalar prefetch when ``bounds`` is set.
+
+    One switch for forward and both backward kernels: bounded paths use a
+    PrefetchScalarGridSpec with the two (B,) bound arrays prepended; index
+    maps take ``*_`` so the appended scalar refs are ignored either way.
+    """
+    if bounds is not None:
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=grid,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                scratch_shapes=scratch,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*bounds, *operands)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*operands)
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, block_q, block_kv
+    *refs, scale, causal, block_q, block_kv, bounded
 ):
+    if bounded:
+        lo_ref, hi_ref, q_ref, k_ref, v_ref, o_ref, lse_ref = refs[:7]
+        acc_ref, m_ref, l_ref = refs[7:]
+        lo, hi = lo_ref[pl.program_id(0)], hi_ref[pl.program_id(0)]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        lo = hi = None
     i, j = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -75,8 +131,8 @@ def _fwd_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: skip KV blocks entirely above the diagonal
-    live = (not causal) or (j * block_kv <= i * block_q + block_q - 1)
+    # skip KV blocks above the causal diagonal or outside the KV bounds
+    live = _block_live(causal, i, j, block_q, block_kv, lo, hi)
 
     @pl.when(live)
     def _body():
@@ -85,12 +141,19 @@ def _fwd_kernel(
         s = _dot(q, k, trans_b=True) * scale          # (BQ, BKV) fp32
         if causal:
             s = _causal_mask(s, i, j, block_q, block_kv)
+        if bounded:
+            s = _bounds_mask(s, j, block_kv, lo, hi)
         m_prev = m_ref[:, :1]                          # (BQ, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_next = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_next)
         p = jnp.exp(s - m_next)                        # (BQ, BKV)
+        if bounded:
+            # a row whose causal∩bounds window is empty has m_next ==
+            # NEG_INF, making exp(s - m_next) = 1 on masked cols; such
+            # rows must contribute nothing (their output finalizes to 0)
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
         l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + _dot(p.astype(v_ref.dtype), v_ref[0, 0])
         m_ref[:] = jnp.broadcast_to(m_next, m_ref.shape)
@@ -110,40 +173,43 @@ def _fwd_kernel(
         ).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
-    """q: (B, H, Sq, Dp); k/v: (B, Hkv, Sk, Dp). Returns (out, lse)."""
+def _flash_fwd(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret):
+    """q: (B, H, Sq, Dp); k/v: (B, Hkv, Sk, Dp); kv_lo/kv_hi: (B,) int32
+    valid-key bounds or None.  Returns (out, lse)."""
     b, h, s_q, d = q.shape
     h_kv, s_k = k.shape[1], k.shape[2]
     rep = h // h_kv
     nq, nk = s_q // block_q, s_k // block_kv
+    bounded = kv_lo is not None
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv,
+        block_q=block_q, block_kv=block_kv, bounded=bounded,
     )
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s_q, LANES), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q, k, v)
+    # *_: PrefetchScalarGridSpec appends the scalar refs to index-map args
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j, *_: (b, h // rep, j, 0)),
+        pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j, *_: (b, h // rep, j, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j, *_: (b, h, i, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, s_q, LANES), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+    ]
+    out, lse = _maybe_bounded_call(
+        kernel, (b, h, nq, nk), in_specs, out_specs, out_shape,
+        scratch_shapes, interpret,
+        (kv_lo, kv_hi) if bounded else None, (q, k, v),
+    )
     return out, lse
 
 
@@ -153,9 +219,15 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale, causal, block_q, block_kv
+    *refs, scale, causal, block_q, block_kv, bounded
 ):
+    if bounded:
+        lo_ref, hi_ref = refs[:2]
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs[2:]
+        lo, hi = lo_ref[pl.program_id(0)], hi_ref[pl.program_id(0)]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        lo = hi = None
     i, j = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -163,7 +235,7 @@ def _dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = (not causal) or (j * block_kv <= i * block_q + block_q - 1)
+    live = _block_live(causal, i, j, block_q, block_kv, lo, hi)
 
     @pl.when(live)
     def _body():
@@ -172,7 +244,13 @@ def _dq_kernel(
         s = _dot(q, k, trans_b=True) * scale
         if causal:
             s = _causal_mask(s, i, j, block_q, block_kv)
+        if bounded:
+            s = _bounds_mask(s, j, block_kv, lo, hi)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])                      # (BQ, BKV)
+        if bounded:
+            # empty-window rows carry lse == NEG_INF: exp(NEG_INF - NEG_INF)
+            # would be 1 on their masked cols; they must not contribute
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
         dp = _dot(do_ref[0, 0], v_ref[0, 0], trans_b=True)         # (BQ, BKV)
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dq_acc[:] += _dot(ds.astype(k.dtype), k)
@@ -183,9 +261,17 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, scale, causal, block_q, block_kv, nq
+    *refs, scale, causal, block_q, block_kv, nq, bounded
 ):
+    if bounded:
+        lo_ref, hi_ref = refs[:2]
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs[2:]
+        lo, hi = lo_ref[pl.program_id(0)], hi_ref[pl.program_id(0)]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
+        lo = hi = None
     j, t = pl.program_id(2), pl.program_id(3)   # kv block, fused (rep, q block)
     i = t % nq                                  # q block within the group step
     nt = pl.num_programs(3)
@@ -195,7 +281,7 @@ def _dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = (not causal) or (j * block_kv <= i * block_q + block_q - 1)
+    live = _block_live(causal, i, j, block_q, block_kv, lo, hi)
 
     @pl.when(live)
     def _body():
@@ -205,7 +291,11 @@ def _dkv_kernel(
         s = _dot(q, k, trans_b=True) * scale                       # (BQ, BKV)
         if causal:
             s = _causal_mask(s, i, j, block_q, block_kv)
+        if bounded:
+            s = _bounds_mask(s, j, block_kv, lo, hi)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        if bounded:
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
         pt = p.astype(do.dtype).T
         dv_acc[:] += _dot(pt, do)                                  # (BKV, D)
         dp = _dot(do, v_ref[0, 0], trans_b=True)                   # (BQ, BKV)
@@ -219,75 +309,87 @@ def _dkv_kernel(
 
 
 def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, kv_lo, kv_hi, out, lse = res
     b, h, s_q, d = q.shape
     h_kv, s_k = k.shape[1], k.shape[2]
     rep = h // h_kv
     nq, nk = s_q // block_q, s_k // block_kv
     do = g.astype(q.dtype)
+    bounded = kv_lo is not None
 
     # delta_i = sum_d dO_i * O_i — tiny elementwise reduce; XLA fuses it.
     # Broadcast over a 128-lane minor dim like lse (TPU block tiling).
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
+    def _call(kernel, grid, in_specs, out_specs, out_shape, scratch, operands):
+        return _maybe_bounded_call(
+            kernel, grid, in_specs, out_specs, out_shape, scratch,
+            interpret, (kv_lo, kv_hi) if bounded else None, operands,
+        )
+
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv,
+        block_q=block_q, block_kv=block_kv, bounded=bounded,
     )
-    dq = pl.pallas_call(
+    dq = _call(
         dq_kernel,
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
+        (b, h, nq, nk),
+        [
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j, *_: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j, *_: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j, *_: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j, *_: (b, h, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        [pltpu.VMEM((block_q, d), jnp.float32)],
+        (q, k, v, do, lse, delta),
+    )
 
     # dk/dv: one sequential pass per KV block over (group rep × q blocks),
     # so shared GQA KV heads accumulate all their query heads' contributions
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv, nq=nq,
+        block_q=block_q, block_kv=block_kv, nq=nq, bounded=bounded,
     )
 
-    def qh(b, hkv, j, t):
+    def qh(b, hkv, j, t, *_):
         return (b, hkv * rep + t // nq, t % nq, 0)
 
-    dk, dv = pl.pallas_call(
+    dk, dv = _call(
         dkv_kernel,
-        grid=(b, h_kv, nk, rep * nq),
-        in_specs=[
+        (b, h_kv, nk, rep * nq),
+        [
             pl.BlockSpec((1, 1, block_q, d), qh),
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t, *_: (b, hkv, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t, *_: (b, hkv, j, 0)),
             pl.BlockSpec((1, 1, block_q, d), qh),
             pl.BlockSpec((1, 1, block_q, LANES), qh),
             pl.BlockSpec((1, 1, block_q, LANES), qh),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t: (b, hkv, j, 0)),
+        [
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t, *_: (b, hkv, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, hkv, j, t, *_: (b, hkv, j, 0)),
         ],
-        out_shape=[
+        [
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        scratch_shapes=[
+        [
             pltpu.VMEM((block_kv, d), jnp.float32),
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+        (q, k, v, do, lse, delta),
+    )
+    if not bounded:
+        return dq, dk, dv, None, None
+    import numpy as np
+
+    z = lambda a: np.zeros(a.shape, jax.dtypes.float0)  # noqa: E731
+    return dq, dk, dv, z(kv_lo), z(kv_hi)
 
 
 # --------------------------------------------------------------------------
@@ -295,15 +397,19 @@ def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_kv, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret):
+    out, _ = _flash_fwd(
+        q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret
+    )
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret):
+    out, lse = _flash_fwd(
+        q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret
+    )
+    return out, (q, k, v, kv_lo, kv_hi, out, lse)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
@@ -315,6 +421,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_start: Optional[jax.Array] = None,
+    kv_stop: Optional[jax.Array] = None,
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
@@ -322,6 +430,13 @@ def flash_attention(
     """Flash attention over framework-layout tensors.
 
     q: (B, Sq, H, D); k, v: (B, Sk, Hkv, D) with Hkv | H (GQA).
+    ``kv_start``/``kv_stop``: optional (B,) int32 per-row valid-key
+    windows — keys outside [start, stop) are masked (right-padded BERT
+    batches: stop = lengths; left-padded prompts: start = pad counts).
+    Blocks fully outside a row's window are skipped, so short rows in a
+    long-padded batch cost proportionally less.  A query row whose
+    causal∩window key set is empty outputs 0 (NOT the uniform average
+    the XLA reference degrades to — such rows are padding by contract).
     Returns (B, Sq, H, D). Differentiable (custom VJP).
     """
     b, s_q, h, d = q.shape
@@ -338,6 +453,17 @@ def flash_attention(
         interpret = jax.default_backend() not in ("tpu", "axon")
     scale = scale if scale is not None else 1.0 / (d**0.5)
 
+    kv_lo = kv_hi = None
+    if kv_start is not None or kv_stop is not None:
+        kv_lo = (
+            jnp.zeros((b,), jnp.int32) if kv_start is None
+            else kv_start.astype(jnp.int32)
+        )
+        kv_hi = (
+            jnp.full((b,), s_k, jnp.int32) if kv_stop is None
+            else kv_stop.astype(jnp.int32)
+        )
+
     # (B, S, H, D) -> (B, H, S, D); pad head_dim to a lane multiple
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -347,8 +473,8 @@ def flash_attention(
         pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
         qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
 
-    out = _flash(qt, kt, vt, float(scale), bool(causal), block_q, block_kv,
-                 bool(interpret))
+    out = _flash(qt, kt, vt, kv_lo, kv_hi, float(scale), bool(causal),
+                 block_q, block_kv, bool(interpret))
     if d_pad:
         out = out[..., :d]
     return jnp.swapaxes(out, 1, 2)
