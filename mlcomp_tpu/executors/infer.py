@@ -40,14 +40,18 @@ def _find_ckpt_dir(ctx: ExecutionContext, args: Dict[str, Any]) -> Optional[str]
 
 def _restore_trainer(ctx: ExecutionContext, cfg: Dict[str, Any], verb: str):
     """Build a Trainer from ``cfg`` and restore the upstream checkpoint
-    (shared by infer/valid/generate so resolution can't diverge)."""
-    from mlcomp_tpu.io.checkpoint import restore_checkpoint
+    (shared by infer/valid/generate so resolution can't diverge).
+
+    Weights-only restore: these stages never step the optimizer, so the
+    train task's optimizer config (which shapes the saved opt_state tree)
+    must not be required here."""
+    from mlcomp_tpu.io.checkpoint import restore_eval_state
     from mlcomp_tpu.train.loop import Trainer
 
     trainer = Trainer(cfg)
     ckpt_dir = _find_ckpt_dir(ctx, cfg)
     if ckpt_dir:
-        trainer.state = restore_checkpoint(ckpt_dir, trainer.state)
+        trainer.state = restore_eval_state(ckpt_dir, trainer.state)
         ctx.log(f"restored checkpoint from {ckpt_dir}")
     else:
         ctx.log(f"no checkpoint found; {verb} with fresh params", level="warning")

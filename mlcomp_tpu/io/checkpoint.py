@@ -82,6 +82,68 @@ def latest_step(directory: str | Path) -> Optional[int]:
         return mgr.latest_step()
 
 
+def restore_eval_state(directory: str | Path, state: Any, step: Optional[int] = None):
+    """Weights-only restore for eval/infer/generate tasks.
+
+    Reads the saved tree WITHOUT a target, so the on-disk optimizer state
+    — whose structure depends on the TRAIN task's optimizer config (adamw
+    + grad-clip chains etc.) — is ignored entirely instead of failing the
+    structure match.  Downstream stages therefore never need to repeat
+    the train stage's optimizer config.  When the checkpoint carries EMA
+    weights they become the restored params (same policy as
+    ``restore_checkpoint`` grafting into a non-EMA target).  Restored
+    arrays are placed onto the shardings of ``state``'s arrays.
+    """
+    directory = Path(directory).absolute()
+    with _mgr(directory) as mgr:
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+        raw = None
+        try:
+            # targeted partial restore: transforms={} + a partial item
+            # drops unmatched saved keys (opt_state — potentially several
+            # times the param bytes) WITHOUT materializing them; restored
+            # arrays land directly on the item's shardings
+            item = {
+                "params": state.params,
+                "model_state": state.model_state,
+                "step": state.step,
+            }
+            probe_ema = {
+                **item, "ema_params": jax.tree.map(lambda p: p, state.params)
+            }
+            try:
+                raw = mgr.restore(
+                    step,
+                    args=ocp.args.PyTreeRestore(item=probe_ema, transforms={}),
+                )
+            except ValueError:
+                raw = mgr.restore(
+                    step, args=ocp.args.PyTreeRestore(item=item, transforms={})
+                )
+        except Exception:
+            # orbax API variance: fall back to an untargeted full read
+            # (correct, but materializes the saved opt_state on host too)
+            raw = mgr.restore(step)
+
+    def place(old, new):
+        arr = jax.numpy.asarray(new)
+        if hasattr(old, "sharding"):
+            return jax.device_put(arr, old.sharding)
+        return arr
+
+    weights = raw.get("ema_params") or raw.get("params")
+    return state.replace(
+        params=jax.tree.map(place, state.params, weights),
+        model_state=jax.tree.map(
+            place, state.model_state, raw.get("model_state") or {}
+        ),
+        step=place(state.step, raw.get("step", state.step)),
+        ema_params=None,
+    )
+
+
 def restore_checkpoint(
     directory: str | Path, target: Any, step: Optional[int] = None
 ) -> Any:

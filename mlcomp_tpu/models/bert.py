@@ -31,13 +31,16 @@ class TransformerLayer(nn.Module):
     dropout: float = 0.0
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False, kv_stop=None):
+    def __call__(self, x, mask=None, train: bool = False, kv_start=None,
+                 kv_stop=None):
         h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         d_head = self.hidden // self.heads
         q = nn.DenseGeneral((self.heads, d_head), dtype=self.dtype, name="q")(h)
         k = nn.DenseGeneral((self.heads, d_head), dtype=self.dtype, name="k")(h)
         v = nn.DenseGeneral((self.heads, d_head), dtype=self.dtype, name="v")(h)
-        attn = dot_product_attention(q, k, v, mask=mask, kv_stop=kv_stop)
+        attn = dot_product_attention(
+            q, k, v, mask=mask, kv_start=kv_start, kv_stop=kv_stop
+        )
         attn = nn.DenseGeneral(
             self.hidden, axis=(-2, -1), dtype=self.dtype, name="out"
         )(attn)
@@ -65,26 +68,29 @@ class Bert(nn.Module):
     num_classes: Optional[int] = 2   # None -> masked-LM head over vocab
     dropout: float = 0.0
     dtype: str = "bfloat16"
-    # "right": pads are a contiguous tail (standard tokenizers) — padding
-    # becomes a per-row kv_stop window and attention stays on the flash
-    # kernel.  "dense": boolean mask from ids != 0, correct for ANY pad
-    # placement (left padding, id-0 inside sequences) at the cost of the
-    # XLA attention path.
-    pad_mode: str = "right"
+    # "dense" (default): boolean key mask from ids != 0 — correct for ANY
+    # pad placement, runs attention on the XLA path.  "window": pads form
+    # one contiguous run per row (standard left- OR right-padded batches)
+    # — padding becomes a per-row [kv_start, kv_stop) window and
+    # attention stays on the flash kernel.  Opt in knowingly: an id-0
+    # token INSIDE a sequence silently mis-masks under "window".
+    pad_mode: str = "dense"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         dtype = jnp.dtype(self.dtype)
         ids = x.astype(jnp.int32)
         # key padding from token id 0 (see pad_mode)
-        mask = kv_stop = None
-        if self.pad_mode == "right":
-            kv_stop = jnp.sum((ids != 0).astype(jnp.int32), axis=-1)
+        mask = kv_start = kv_stop = None
+        if self.pad_mode == "window":
+            valid = (ids != 0).astype(jnp.int32)
+            kv_start = jnp.argmax(valid, axis=-1).astype(jnp.int32)
+            kv_stop = kv_start + jnp.sum(valid, axis=-1)
         elif self.pad_mode == "dense":
             mask = (ids != 0)[:, None, None, :]  # (B,1,1,S)
         else:
             raise ValueError(
-                f"pad_mode must be 'right' or 'dense', got {self.pad_mode!r}"
+                f"pad_mode must be 'dense' or 'window', got {self.pad_mode!r}"
             )
 
         tok = nn.Embed(self.vocab_size, self.hidden, dtype=dtype, name="tok_emb")(ids)
@@ -100,7 +106,7 @@ class Bert(nn.Module):
         for _ in range(self.layers):
             h = TransformerLayer(
                 self.hidden, self.heads, self.mlp_dim, dtype, self.dropout
-            )(h, mask=mask, train=train, kv_stop=kv_stop)
+            )(h, mask=mask, train=train, kv_start=kv_start, kv_stop=kv_stop)
         h = nn.LayerNorm(dtype=dtype, param_dtype=jnp.float32)(h)
 
         if self.num_classes is None:

@@ -174,3 +174,69 @@ def test_longcontext_family_trains(tmp_path):
     }
     result = _run_train(args)
     assert result is not None
+
+
+def test_train_generate_dag(tmp_path):
+    """Tiny analog of configs/generate_lm.yml: train a decoder LM, then the
+    generate stage restores it via the dependency edge and samples."""
+    import numpy as np
+
+    model = {
+        "name": "transformer_lm",
+        "vocab_size": 32,
+        "hidden": 16,
+        "layers": 1,
+        "heads": 2,
+        "dtype": "float32",
+    }
+    out = tmp_path / "gen.npz"
+    dag = {
+        "info": {"name": "gen", "project": "t"},
+        "executors": {
+            "train": {
+                "type": "train",
+                "stage": "train",
+                "args": {
+                    "model": model,
+                    "optimizer": {"name": "adam", "lr": 1e-3},
+                    "loss": "lm_cross_entropy",
+                    "metrics": [],
+                    "epochs": 1,
+                    "data": {
+                        "train": {
+                            "name": "synthetic_tokens",
+                            "n": 16,
+                            "seq_len": 16,
+                            "vocab_size": 32,
+                            "batch_size": 8,
+                        }
+                    },
+                    "storage_root": str(tmp_path / "storage"),
+                },
+            },
+            "sample": {
+                "type": "generate",
+                "stage": "infer",
+                "depends": "train",
+                "args": {
+                    "model": model,
+                    "data": {
+                        "infer": {
+                            "name": "synthetic_tokens",
+                            "n": 8,
+                            "seq_len": 8,
+                            "vocab_size": 32,
+                            "batch_size": 8,
+                        }
+                    },
+                    "max_new_tokens": 4,
+                    "out": str(out),
+                },
+            },
+        },
+    }
+    statuses = run_dag_local(dag, db_path=str(tmp_path / "db.sqlite"),
+                             workdir=str(tmp_path))
+    assert all(s == TaskStatus.SUCCESS for s in statuses.values()), statuses
+    ids = np.load(out)["ids"]
+    assert ids.shape == (8, 12)

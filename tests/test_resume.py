@@ -146,3 +146,39 @@ def test_async_writer_overlapped_saves(tmp_path):
         int(p.name) for p in (tmp_path / "ck").iterdir() if p.name.isdigit()
     )
     assert len(kept) <= 2 and kept[-1] == 4
+
+
+def test_eval_restore_ignores_optimizer_mismatch(tmp_path):
+    """valid/infer/generate stages restore weights-only: a train task's
+    adamw+grad-clip opt_state tree must not be required downstream."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlcomp_tpu.io.checkpoint import restore_eval_state, save_checkpoint
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.train.optim import create_optimizer
+    from mlcomp_tpu.train.state import TrainState, init_model
+
+    model = create_model({"name": "mlp", "num_classes": 4, "hidden": [8]})
+    params, ms = init_model(
+        model, {"x": jnp.zeros((1, 6))}, jax.random.PRNGKey(0)
+    )
+    train_tx = create_optimizer(
+        {"name": "adamw", "lr": 1e-3, "grad_clip": 1.0}
+    )
+    trained = TrainState.create(model.apply, params, train_tx, ms,
+                                ema_decay=0.9)
+    save_checkpoint(tmp_path / "ck", trained, step=3)
+
+    eval_tx = create_optimizer({"name": "sgd", "lr": 0.1})
+    p2, ms2 = init_model(model, {"x": jnp.zeros((1, 6))}, jax.random.PRNGKey(1))
+    fresh = TrainState.create(model.apply, p2, eval_tx, ms2)
+    restored = restore_eval_state(tmp_path / "ck", fresh)
+    # EMA weights become the params (trained state tracked EMA)
+    for a, b in zip(
+        jax.tree.leaves(restored.params), jax.tree.leaves(trained.ema_params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == int(trained.step)
+    assert restored.ema_params is None
