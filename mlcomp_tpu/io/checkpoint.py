@@ -110,9 +110,7 @@ def restore_eval_state(directory: str | Path, state: Any, step: Optional[int] = 
                 "model_state": state.model_state,
                 "step": state.step,
             }
-            probe_ema = {
-                **item, "ema_params": jax.tree.map(lambda p: p, state.params)
-            }
+            probe_ema = {**item, "ema_params": state.params}
             try:
                 raw = mgr.restore(
                     step,

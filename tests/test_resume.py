@@ -169,6 +169,7 @@ def test_eval_restore_ignores_optimizer_mismatch(tmp_path):
     )
     trained = TrainState.create(model.apply, params, train_tx, ms,
                                 ema_decay=0.9)
+    trained = trained.replace(step=jnp.asarray(7, jnp.int32))
     save_checkpoint(tmp_path / "ck", trained, step=3)
 
     eval_tx = create_optimizer({"name": "sgd", "lr": 0.1})
@@ -180,5 +181,17 @@ def test_eval_restore_ignores_optimizer_mismatch(tmp_path):
         jax.tree.leaves(restored.params), jax.tree.leaves(trained.ema_params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
-    assert int(restored.step) == int(trained.step)
+    assert int(restored.step) == 7  # internal counter, not the ckpt index
     assert restored.ema_params is None
+
+    # non-EMA checkpoint: plain params restore through the probe fallback
+    plain = TrainState.create(model.apply, params, train_tx, ms)
+    plain = plain.replace(
+        params=jax.tree.map(lambda p: p + 1.0, plain.params)
+    )
+    save_checkpoint(tmp_path / "ck2", plain, step=1)
+    restored2 = restore_eval_state(tmp_path / "ck2", fresh)
+    for a, b in zip(
+        jax.tree.leaves(restored2.params), jax.tree.leaves(plain.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
