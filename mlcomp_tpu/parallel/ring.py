@@ -30,10 +30,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, row0, col0, causal, scale):
-    """One Q-shard × KV-shard tile, GQA-aware, fp32 accumulation.
+# KV chunk for the within-shard online softmax: logits materialize as
+# (Sq, KV_CHUNK) tiles instead of the full (Sq, S_local) — at S_local=4k+
+# the un-chunked tile would be GBs of fp32 per ring step (XLA does not
+# fuse einsum→softmax→einsum into a streaming loop on its own)
+KV_CHUNK = 1024
 
-    Returns (unnormalized_out, block_max, block_sum) for online merging.
+
+def _tile_attn(q, k, v, row0, col0, causal, scale):
+    """One Q-shard × KV-chunk tile, GQA-aware, fp32 accumulation.
+
+    Returns (unnormalized_out, tile_max, tile_sum) for online merging.
     q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D); row0/col0: global offsets.
     """
     b, s_q, h, d = q.shape
@@ -52,6 +59,65 @@ def _block_attn(q, k, v, row0, col0, causal, scale):
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum("bhrqk,bkhd->bhrqd", p.astype(v.dtype), v).astype(jnp.float32)
     return o, m_safe, l
+
+
+def _online_merge(acc, m, l, o_b, m_b, l_b):
+    """Online-softmax merge of a new (out, max, sum) tile into the running
+    accumulators — the ONE definition both the inner KV-chunk scan and the
+    outer ring scan use."""
+    m_new = jnp.maximum(m, m_b)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(m_b - m_new)
+    return acc * alpha + o_b * beta, m_new, l * alpha + l_b * beta
+
+
+def _zero_carry(b, h_kv, rep, s_q, d, like):
+    """(acc0, m0, l0) scan carries.  ``+ zero`` imprints ``like``'s
+    device-varying axes: under shard_map the carry types must match the
+    (varying) tile outputs or the scan carry check fails."""
+    zero = like.reshape(-1)[0].astype(jnp.float32) * 0.0
+    return (
+        jnp.zeros((b, h_kv, rep, s_q, d), jnp.float32) + zero,
+        jnp.full((b, h_kv, rep, s_q, 1), NEG_INF / 2, jnp.float32) + zero,
+        jnp.zeros((b, h_kv, rep, s_q, 1), jnp.float32) + zero,
+    )
+
+
+def _block_attn(q, k, v, row0, col0, causal, scale):
+    """Q-shard × KV-shard attention with (Sq, KV_CHUNK)-bounded logits.
+
+    Same (unnormalized_out, max, sum) contract as :func:`_tile_attn`; when
+    the KV shard exceeds ``KV_CHUNK`` it is streamed through an inner
+    ``lax.scan`` (plus one remainder tile when the shard is not a chunk
+    multiple — the memory bound must not silently vanish for ragged
+    shards).  Pure jnp, so the backward pass stays automatic;
+    ``jax.checkpoint`` on the tile keeps the scan from saving per-chunk
+    logits for it.
+    """
+    b, s_q, h, d = q.shape
+    s_k, h_kv = k.shape[1], k.shape[2]
+    rep = h // h_kv
+    if s_k <= KV_CHUNK:
+        return _tile_attn(q, k, v, row0, col0, causal, scale)
+
+    tile = jax.checkpoint(partial(_tile_attn, causal=causal, scale=scale))
+    nc = s_k // KV_CHUNK
+    main = nc * KV_CHUNK
+
+    def chunk_step(carry, ci):
+        acc, m, l = carry
+        k_c = jax.lax.dynamic_slice_in_dim(k, ci * KV_CHUNK, KV_CHUNK, 1)
+        v_c = jax.lax.dynamic_slice_in_dim(v, ci * KV_CHUNK, KV_CHUNK, 1)
+        o_b, m_b, l_b = tile(q, k_c, v_c, row0, col0 + ci * KV_CHUNK)
+        return _online_merge(acc, m, l, o_b, m_b, l_b), None
+
+    (acc, m, l), _ = jax.lax.scan(
+        chunk_step, _zero_carry(b, h_kv, rep, s_q, d, q), jnp.arange(nc)
+    )
+    if main < s_k:
+        o_b, m_b, l_b = tile(q, k[:, main:], v[:, main:], row0, col0 + main)
+        acc, m, l = _online_merge(acc, m, l, o_b, m_b, l_b)
+    return acc, m, l
 
 
 def ring_attention(
@@ -87,21 +153,10 @@ def ring_attention(
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, row0, src * s_k, causal, scale)
-        m_new = jnp.maximum(m, m_b)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(m_b - m_new)
-        acc = acc * alpha + o_b * beta
-        l = l * alpha + l_b * beta
-        return (k_nxt, v_nxt, acc, m_new, l), None
+        acc, m, l = _online_merge(acc, m, l, o_b, m_b, l_b)
+        return (k_nxt, v_nxt, acc, m, l), None
 
-    # the zero-init accumulators must carry the same varying-axes type as
-    # the inputs (their values diverge per device from step 0) or the scan
-    # carry types won't match; a zero scalar derived from q inherits
-    # exactly the axes the enclosing shard_map shards over
-    zero = q.reshape(-1)[0].astype(jnp.float32) * 0.0
-    acc0 = jnp.zeros((b, h_kv, rep, s_q, d), jnp.float32) + zero
-    m0 = jnp.full((b, h_kv, rep, s_q, 1), NEG_INF / 2, jnp.float32) + zero
-    l0 = jnp.zeros((b, h_kv, rep, s_q, 1), jnp.float32) + zero
+    acc0, m0, l0 = _zero_carry(b, h_kv, rep, s_q, d, q)
     (_, _, acc, m, l), _ = jax.lax.scan(
         step, (k, v, acc0, m0, l0), jnp.arange(n), length=n
     )
