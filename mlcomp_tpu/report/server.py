@@ -87,13 +87,16 @@ pre{background:var(--panel);border:1px solid var(--border);color:var(--text2);
 <div id="compare" class="charts"></div>
 <h2>Tasks</h2><table id="tasks"></table>
 <h2>Workers</h2><table id="workers"></table>
+<h2>Models</h2><table id="models"></table>
 <h2>Task detail <span id="tasksel"></span></h2>
 <div id="charts" class="charts"></div>
 <div id="reports"></div>
 <pre id="detail">select a task</pre>
 <div id="tip" class="tip"></div>
 <script>
-const J=u=>fetch(u).then(r=>r.json());
+const TOK=new URLSearchParams(location.search).get('token');
+const HDRS=TOK?{'Authorization':'Bearer '+TOK}:{};
+const J=u=>fetch(u,{headers:HDRS}).then(r=>r.json());
 const SVG=(t,a)=>{const e=document.createElementNS('http://www.w3.org/2000/svg',t);
  for(const k in a)e.setAttribute(k,a[k]);return e};
 let curDag=null,curTask=null;const repCache=new Map();
@@ -295,7 +298,7 @@ async function refresh(){
  t.innerHTML='';row(t,['id','name','project','status','tasks','actions'],true);
  const act=d=>{const span=document.createElement('span');
   const P=(verb)=>fetch('/api/dags/'+d.id+'/'+verb,{method:'POST',
-   headers:{'X-Requested-With':'mlcomp-tpu'}}).then(()=>refresh());
+   headers:{'X-Requested-With':'mlcomp-tpu',...HDRS}}).then(()=>refresh());
   if(d.status==='in_progress')span.appendChild(link('stop',()=>P('stop')));
   else if(d.status!=='success')span.appendChild(link('restart',()=>P('restart')));
   return span};
@@ -312,7 +315,7 @@ async function refresh(){
   row(tt,['id','name','executor','stage','status','worker','error','actions'],true);
   const tact=x=>{const span=document.createElement('span');
    const P=(verb)=>fetch('/api/tasks/'+x.id+'/'+verb,{method:'POST',
-    headers:{'X-Requested-With':'mlcomp-tpu'}}).then(()=>refresh());
+    headers:{'X-Requested-With':'mlcomp-tpu',...HDRS}}).then(()=>refresh());
    if(['not_ran','queued','in_progress'].includes(x.status))
     span.appendChild(link('stop',()=>P('stop')));
    else span.appendChild(link('restart',()=>P('restart')));
