@@ -10,7 +10,7 @@ no-network environment and benchmarking (deterministic, seeded).
 from __future__ import annotations
 
 from pathlib import Path
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -177,10 +177,21 @@ def image_folder(
 
 
 @DATASETS.register("npz")
-def npz(path: str, x_key: str = "x", y_key: str = "y", **_) -> Dict[str, np.ndarray]:
-    """Load arrays from an .npz file on host disk (the model-storage path)."""
+def npz(
+    path: str, x_key: str = "x", y_key: Optional[str] = None, **_
+) -> Dict[str, np.ndarray]:
+    """Load arrays from an .npz file on host disk (the model-storage path).
+
+    With the default ``y_key`` the ``y`` array is optional (a generation
+    prompt set has no labels); an EXPLICITLY configured ``y_key`` must
+    exist — a typo should fail at load, not as a label-free training run."""
     with np.load(Path(path)) as f:
-        return {"x": f[x_key], "y": f[y_key]}
+        out = {"x": f[x_key]}
+        if y_key is not None:
+            out["y"] = f[y_key]
+        elif "y" in f:
+            out["y"] = f["y"]
+        return out
 
 
 def create_dataset(cfg: Dict[str, Any]) -> Dict[str, np.ndarray]:

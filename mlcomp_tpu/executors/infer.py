@@ -306,6 +306,11 @@ class GenerateExecutor(Executor):
         if knobs["eos_id"] is not None:
             knobs["eos_id"] = int(knobs["eos_id"])
         seed = int(cfg.pop("gen_seed", 0))
+        # Real npz token datasets are LEFT-padded with pad_id; without the
+        # mask, pad slots would attend as real context with wrong RoPE
+        # positions.  Opt out (`mask_prompt_padding: false`) only for
+        # fixed-length unpadded prompt sets.
+        mask_padding = bool(cfg.pop("mask_prompt_padding", True))
         quantize = bool(cfg.pop("quantize", False))
         # opt-in decode-time weight pre-cast (weights are read once per
         # token; bf16 is a measured ~1.4x decode win over fp32 masters,
@@ -331,8 +336,17 @@ class GenerateExecutor(Executor):
         rng = jax.random.PRNGKey(seed)
         for batch in trainer._loader(split):
             rng, sub = jax.random.split(rng)
+            kwargs = {}
+            if mask_padding:
+                # Left-pad contract: a row is real from its first non-pad
+                # token onward (cumulative-or), so a mid-prompt token that
+                # happens to equal pad_id is never masked out.
+                x = np.asarray(batch["x"])
+                kwargs["prompt_mask"] = np.logical_or.accumulate(
+                    x != knobs["pad_id"], axis=1
+                )
             ids = np.asarray(
-                gen_fn(variables, prompt=batch["x"], rng=sub)
+                gen_fn(variables, prompt=batch["x"], rng=sub, **kwargs)
             )
             if "valid" in batch:
                 ids = ids[np.asarray(batch["valid"]) > 0]

@@ -14,12 +14,15 @@ import time
 from pathlib import Path
 from typing import Any, Dict, Optional
 
-DEFAULT_ROOT = os.environ.get("MLCOMP_TPU_STORAGE", "~/.mlcomp_tpu/models")
+DEFAULT_ROOT = "~/.mlcomp_tpu/models"
 
 
 class ModelStorage:
     def __init__(self, root: Optional[str] = None):
-        self.root = Path(root or DEFAULT_ROOT).expanduser().absolute()
+        # env read per-construction, not at import: the report server and
+        # tests may (re)point MLCOMP_TPU_STORAGE after this module loads
+        root = root or os.environ.get("MLCOMP_TPU_STORAGE") or DEFAULT_ROOT
+        self.root = Path(root).expanduser().absolute()
 
     def task_dir(self, project: str, dag: str, task: str) -> Path:
         d = self.root / project / dag / task

@@ -128,13 +128,15 @@ def dot_product_attention(
                     kv_start=kv_start, kv_stop=kv_stop,
                 )
             except (ImportError, NotImplementedError) as e:
-                if forced:  # explicit request must not fail silently
-                    warnings.warn(
-                        f"MLCOMP_TPU_FLASH forced on but flash attention "
-                        f"unavailable ({type(e).__name__}: {e}); using "
-                        f"reference path",
-                        stacklevel=2,
-                    )
+                # any true fallback is loud: the XLA path is O(S^2) memory
+                # and silently eating it on TPU hides a perf cliff
+                # (warnings dedupe per call site, so this fires once)
+                warnings.warn(
+                    f"flash attention unavailable "
+                    f"({type(e).__name__}: {e}); using O(S^2) reference "
+                    f"path on TPU",
+                    stacklevel=2,
+                )
     return reference_attention(
         q, k, v, mask=mask, causal=causal, scale=scale,
         kv_start=kv_start, kv_stop=kv_stop,

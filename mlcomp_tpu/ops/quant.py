@@ -33,9 +33,16 @@ _SKEY = "q8_scale"
 
 
 def quantize_leaf(w: jax.Array) -> Dict[str, jax.Array]:
-    """Per-output-channel (last axis) absmax int8 quantization."""
+    """Per-output-channel (last axis) absmax int8 quantization.
+
+    Only the input axis (``ndim-2``) is reduced: leading axes are treated
+    as stacked/batch axes, so a scanned per-layer stack ``(L, d_in,
+    d_out)`` gets independent ``(L, 1, d_out)`` scales — one shared scale
+    across layers would let the largest layer's weights crush the
+    resolution of the smallest's.  For 2-D matrices this is exactly the
+    classic per-channel scheme."""
     w32 = w.astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(w32), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    absmax = jnp.max(jnp.abs(w32), axis=w.ndim - 2, keepdims=True)
     scale = jnp.maximum(absmax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return {_QKEY: q, _SKEY: scale.astype(jnp.float32)}

@@ -9,15 +9,26 @@ Endpoints (all JSON unless noted):
 - ``GET /api/tasks/<id>/metrics``           metric names
 - ``GET /api/tasks/<id>/metrics/<name>``    one metric series [[step, value]]
 - ``GET /api/workers``                      worker heartbeats
+- ``GET /api/models``                       model-storage inventory
 
 Each request opens its own Store handle (sqlite connections are not
 thread-safe across the ThreadingHTTPServer pool; WAL mode makes the
 per-request open cheap and concurrent-reader-safe).
+
+Mutation (POST) routes carry two guards: the ``X-Requested-With`` header
+(CSRF — cross-origin browser calls become preflights this server never
+answers) and, when ``MLCOMP_TPU_REPORT_TOKEN`` is set in the server's
+environment, a matching ``Authorization: Bearer <token>`` header (the
+dashboard forwards ``?token=`` from its URL).  With no env token the
+server is open — the reference's dashboard is likewise unauthenticated
+on a trusted network, so auth is opt-in, not mandatory.
 """
 
 from __future__ import annotations
 
+import hmac
 import json
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -43,6 +54,7 @@ _ROUTES = [
     (re.compile(r"^/api/tasks/(\d+)/reports$"), "task_reports"),
     (re.compile(r"^/api/reports/(\d+)$"), "report_payload"),
     (re.compile(r"^/api/workers$"), "workers"),
+    (re.compile(r"^/api/models$"), "models"),
 ]
 
 _DASHBOARD = """<!doctype html>
@@ -328,6 +340,13 @@ async function refresh(){
  for(const w of ws)row(wt,[w.name,w.chips,w.busy_chips,
   [w.status,w.status==='alive'?'success':'failed'],
   new Date(w.heartbeat*1000).toLocaleTimeString()]);
+ const ms=await J('/api/models');const mt=document.getElementById('models');
+ mt.innerHTML='';
+ if(ms.length){row(mt,['project','dag','task','checkpoints','artifacts','updated'],true);
+  for(const m of ms)row(mt,[m.project,m.dag,m.task,
+   m.checkpoints.length?m.checkpoints.join(', '):'—',m.artifacts,
+   m.updated?new Date(m.updated*1000).toLocaleString():'']);}
+ else row(mt,['no stored models'],false);
  // skip the detail rebuild while the user is hovering a chart
  if(curTask!==null&&document.getElementById('tip').style.display!=='block')
   showTask(curTask);
@@ -406,6 +425,12 @@ class _Handler(BaseHTTPRequestHandler):
         if not self.headers.get("X-Requested-With"):
             self._json({"error": "missing X-Requested-With header"}, code=403)
             return
+        secret = os.environ.get("MLCOMP_TPU_REPORT_TOKEN", "")
+        if secret:
+            auth = self.headers.get("Authorization", "")
+            if not hmac.compare_digest(auth, f"Bearer {secret}"):
+                self._json({"error": "invalid or missing token"}, code=403)
+                return
         self._dispatch(_POST_ROUTES)
 
     # ---- route impls -----------------------------------------------------
@@ -458,6 +483,38 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _r_workers(self, store: Store):
         return store.workers()
+
+    def _r_models(self, store: Store):
+        """Read-only walk of the ModelStorage tree (project/dag/task) —
+        deliberately avoids ModelStorage's accessors, which mkdir."""
+        from mlcomp_tpu.io.storage import ModelStorage
+
+        root = ModelStorage().root
+        out = []
+        if not root.is_dir():
+            return out
+        for d in sorted(p for p in root.glob("*/*/*") if p.is_dir()):
+            project, dag, task = d.relative_to(root).parts
+            ckpt_dir, art_dir = d / "checkpoints", d / "artifacts"
+            meta_p = d / "meta.json"
+            try:
+                meta = json.loads(meta_p.read_text()) if meta_p.exists() else {}
+            except (OSError, ValueError):
+                meta = {}
+            out.append({
+                "project": project,
+                "dag": dag,
+                "task": task,
+                "checkpoints": sorted(
+                    (p.name for p in ckpt_dir.iterdir()),
+                    # step dirs are numeric: 7, 9, 10 — not 10, 7, 9
+                    key=lambda n: (not n.isdigit(), int(n) if n.isdigit() else n),
+                ) if ckpt_dir.is_dir() else [],
+                "artifacts": len(list(art_dir.iterdir()))
+                if art_dir.is_dir() else 0,
+                "updated": meta.get("updated"),
+            })
+        return out
 
 
 def make_server(

@@ -77,6 +77,79 @@ def test_small_sequences_fall_back():
         flash_attention(q, q, q)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ragged_length_stays_on_kernel(causal):
+    """S % 128 != 0 pads to a block multiple instead of falling back."""
+    s = 777
+    q = _rand((1, s, 2, 64), 20)
+    k = _rand((1, s, 2, 64), 21)
+    v = _rand((1, s, 2, 64), 22)
+    out = flash_attention(q, k, v, causal=causal)
+    assert out.shape == q.shape
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ragged_length_grads(causal):
+    s = 333
+    q = _rand((1, s, 2, 64), 23)
+    k = _rand((1, s, 2, 64), 24)
+    v = _rand((1, s, 2, 64), 25)
+    w = _rand((1, s, 2, 64), 26)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) * w)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_ragged_length_with_kv_stop():
+    """Ragged S composes with caller-provided key windows."""
+    b, s_q, s_k = 2, 200, 300
+    q = _rand((b, s_q, 2, 64), 27)
+    k = _rand((b, s_k, 2, 64), 28)
+    v = _rand((b, s_k, 2, 64), 29)
+    stop = jnp.asarray([300, 170], jnp.int32)
+    out = flash_attention(q, k, v, kv_stop=stop)
+    ref = reference_attention(
+        q, k, v, mask=_window_mask(b, s_k, np.zeros(b, np.int64), np.asarray(stop))
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_causal_block_skip_numerics():
+    """Multi-block causal (exercises the dead-block index clamping in all
+    three kernels) still matches the reference bit-for-bit-ish."""
+    s = 384
+    q = _rand((1, s, 2, 64), 30)
+    k = _rand((1, s, 2, 64), 31)
+    v = _rand((1, s, 2, 64), 32)
+    w = _rand((1, s, 2, 64), 33)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=128, block_kv=128) * w
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) * w)
+
+    np.testing.assert_allclose(
+        float(loss_flash(q, k, v)), float(loss_ref(q, k, v)), rtol=1e-5
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
 def test_dispatch_env_off(monkeypatch):
     from mlcomp_tpu.ops.attention import dot_product_attention
 

@@ -90,6 +90,58 @@ def test_dashboard_ships_charts_and_graph(served):
         assert needle in html, needle
 
 
+def _post(port, path, headers):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method="POST", headers=headers
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_post_token_auth(served, monkeypatch):
+    """With MLCOMP_TPU_REPORT_TOKEN set, mutation routes demand the Bearer
+    token; without the env var they stay open (CSRF header only)."""
+    import urllib.error
+
+    _, dag_id, _, port = served
+    csrf = {"X-Requested-With": "mlcomp-tpu"}
+
+    monkeypatch.setenv("MLCOMP_TPU_REPORT_TOKEN", "s3cret")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, f"/api/dags/{dag_id}/stop", csrf)
+    assert ei.value.code == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, f"/api/dags/{dag_id}/stop",
+              {**csrf, "Authorization": "Bearer wrong"})
+    assert ei.value.code == 403
+    status, body = _post(
+        port, f"/api/dags/{dag_id}/stop",
+        {**csrf, "Authorization": "Bearer s3cret"},
+    )
+    assert status == 200 and "stopped_tasks" in body
+
+    monkeypatch.delenv("MLCOMP_TPU_REPORT_TOKEN")
+    status, body = _post(port, f"/api/dags/{dag_id}/restart", csrf)
+    assert status == 200 and "reset_tasks" in body
+
+
+def test_api_models(served, tmp_path, monkeypatch):
+    from mlcomp_tpu.io.storage import ModelStorage
+
+    monkeypatch.setenv("MLCOMP_TPU_STORAGE", str(tmp_path / "models"))
+    *_, port = served
+    _, body = _get(port, "/api/models")
+    assert json.loads(body) == []  # empty root
+
+    ms = ModelStorage(str(tmp_path / "models"))
+    (ms.checkpoint_dir("p", "d1", "train") / "7").mkdir()
+    ms.write_meta("p", "d1", "train", {"params": 123})
+    _, body = _get(port, "/api/models")
+    (entry,) = json.loads(body)
+    assert entry["project"] == "p" and entry["task"] == "train"
+    assert entry["checkpoints"] == ["7"] and entry["updated"] is not None
+
+
 def test_dag_level_metric_comparison(served):
     """One metric across all tasks of a DAG — the grid-compare endpoint."""
     store, dag_id, tid, port = served
