@@ -139,7 +139,14 @@ def _cmd_worker(args: argparse.Namespace) -> int:
     from mlcomp_tpu.scheduler.worker import Worker
     from mlcomp_tpu.db.store import Store
 
-    w = Worker(Store(args.db), name=args.name, chips=args.chips)
+    w = Worker(
+        Store(args.db),
+        name=args.name,
+        chips=args.chips,
+        workdir=args.workdir,
+        isolate=not args.in_process,
+        max_tasks=args.max_tasks,
+    )
     w.run_forever(poll_interval=args.poll)
     return 0
 
@@ -209,6 +216,20 @@ def main(argv=None) -> int:
     w.add_argument("--name", default=None)
     w.add_argument("--chips", type=int, default=0)
     w.add_argument("--poll", type=float, default=0.5)
+    w.add_argument("--workdir", default=".")
+    w.add_argument(
+        "--in-process",
+        action="store_true",
+        help="run executors inside the worker process instead of isolated"
+        " per-task children (no crash isolation, no chip pinning, no"
+        " multi-host gangs; mainly for debugging)",
+    )
+    w.add_argument(
+        "--max-tasks",
+        type=int,
+        default=None,
+        help="max concurrent isolated tasks (default: max(1, chips))",
+    )
     w.set_defaults(fn=_cmd_worker)
 
     r = sub.add_parser("report", help="run the report/UI HTTP server")

@@ -97,6 +97,18 @@ class DataLoader:
         if self.mesh is None:
             return {k: jax.numpy.asarray(v) for k, v in batch.items()}
         sharding = batch_sharding(self.mesh)
+        if jax.process_count() > 1:
+            # multi-host SPMD: every process assembles the SAME global
+            # batch (loaders are seed-deterministic), then contributes
+            # only the slices its own devices hold.  make_array_from_
+            # callback hands us the global index per addressable shard,
+            # so this is layout-agnostic — no process/row bookkeeping.
+            return {
+                k: jax.make_array_from_callback(
+                    v.shape, sharding, lambda idx, v=v: v[idx]
+                )
+                for k, v in batch.items()
+            }
         return {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     def __iter__(self):

@@ -87,6 +87,13 @@ CREATE TABLE IF NOT EXISTS workers (
     heartbeat REAL NOT NULL,
     status    TEXT NOT NULL DEFAULT 'alive'
 );
+CREATE TABLE IF NOT EXISTS gang (
+    task_id     INTEGER NOT NULL,
+    slot        INTEGER NOT NULL,
+    worker      TEXT,
+    coordinator TEXT,
+    PRIMARY KEY (task_id, slot)
+);
 """
 
 
@@ -272,6 +279,11 @@ class Store:
                 " status='in_progress'",
                 (dag_id,),
             )
+            c.execute(
+                "DELETE FROM gang WHERE task_id IN"
+                " (SELECT id FROM tasks WHERE dag_id=?)",
+                (dag_id,),
+            )
             return cur.rowcount
 
     def restart_dag(self, dag_id: int) -> int:
@@ -302,6 +314,11 @@ class Store:
                 " AND status IN ('stopped','failed')",
                 (dag_id,),
             )
+            c.execute(
+                "DELETE FROM gang WHERE task_id IN"
+                " (SELECT id FROM tasks WHERE dag_id=?)",
+                (dag_id,),
+            )
             return cur.rowcount
 
     def stop_task(self, task_id: int) -> bool:
@@ -324,6 +341,8 @@ class Store:
                     TaskStatus.IN_PROGRESS.value,
                 ),
             )
+            if cur.rowcount:
+                c.execute("DELETE FROM gang WHERE task_id=?", (task_id,))
             return cur.rowcount > 0
 
     def restart_task(self, task_id: int) -> int:
@@ -393,6 +412,9 @@ class Store:
                 " AND status IN ('stopped','failed','success')",
                 (dag_id,),
             )
+            c.execute(
+                f"DELETE FROM gang WHERE task_id IN ({marks})", to_reset
+            )
             return cur.rowcount
 
     def list_dags(self) -> List[Dict[str, Any]]:
@@ -428,6 +450,12 @@ class Store:
             "SELECT name, status FROM tasks WHERE dag_id=?", (dag_id,)
         ).fetchall()
         return {r["name"]: TaskStatus(r["status"]) for r in rows}
+
+    def task_row(self, task_id: int) -> Optional[Dict[str, Any]]:
+        row = self._conn.execute(
+            "SELECT * FROM tasks WHERE id=?", (task_id,)
+        ).fetchone()
+        return dict(row) if row else None
 
     def task_rows(self, dag_id: int) -> List[Dict[str, Any]]:
         rows = self._conn.execute(
@@ -521,6 +549,8 @@ class Store:
             params += [expect_worker, TaskStatus.IN_PROGRESS.value]
         with self._tx() as c:
             cur = c.execute(q, params)
+            if cur.rowcount == 1:
+                c.execute("DELETE FROM gang WHERE task_id=?", (task_id,))
             return cur.rowcount == 1
 
     def requeue_task(self, task_id: int, expect_worker: Optional[str] = None) -> bool:
@@ -545,7 +575,146 @@ class Store:
             params.append(expect_worker)
         with self._tx() as c:
             cur = c.execute(q, params)
+            if cur.rowcount == 1:
+                # a re-queued multi-host task re-gathers a fresh gang
+                c.execute("DELETE FROM gang WHERE task_id=?", (task_id,))
             return cur.rowcount == 1
+
+    # ------------------------------------------------------------- gang claims
+    #
+    # A ``hosts: n`` task is GANG-scheduled: n workers each claim one slot
+    # of the task's gang, slot 0 elects itself coordinator and publishes a
+    # ``host:port`` rendezvous, and only when every slot is held does the
+    # task itself go IN_PROGRESS (owned by slot 0's worker, so the
+    # existing reap/requeue/finish machinery applies unchanged).  This is
+    # the scheduler-side half of ``parallel/distributed.py``: the workers
+    # spawn one child process per slot with MLCOMP_TPU_COORDINATOR /
+    # _NUM_PROCESSES / _PROCESS_ID set from the gang row.
+
+    def claim_gang_slot(
+        self, worker: str, free_chips: int
+    ) -> Optional[Dict[str, Any]]:
+        """Claim one slot of a queued multi-host task (``chips`` is the
+        per-host requirement).  Returns {"task": row, "slot": i, "hosts": n}
+        or None.  A worker holds at most one slot per task."""
+        rows = self._conn.execute(
+            "SELECT id, hosts FROM tasks WHERE status=? AND hosts>1 AND"
+            " chips<=? ORDER BY priority DESC, id ASC",
+            (TaskStatus.QUEUED.value, free_chips),
+        ).fetchall()
+        for r in rows:
+            try:
+                with self._tx() as c:
+                    # re-check INSIDE the tx: a stop/finish racing this
+                    # claim must not get fresh gang rows resurrected under
+                    # it (WAL snapshot conflicts abort us instead — caught
+                    # below and treated as "lost the race")
+                    chk = c.execute(
+                        "SELECT status FROM tasks WHERE id=?", (r["id"],)
+                    ).fetchone()
+                    if chk is None or chk["status"] != TaskStatus.QUEUED.value:
+                        continue
+                    mine = c.execute(
+                        "SELECT 1 FROM gang WHERE task_id=? AND worker=?",
+                        (r["id"], worker),
+                    ).fetchone()
+                    if mine is not None:
+                        continue
+                    for s in range(r["hosts"]):
+                        c.execute(
+                            "INSERT OR IGNORE INTO gang (task_id, slot)"
+                            " VALUES (?,?)",
+                            (r["id"], s),
+                        )
+                    free = c.execute(
+                        "SELECT MIN(slot) AS s FROM gang WHERE task_id=?"
+                        " AND worker IS NULL",
+                        (r["id"],),
+                    ).fetchone()
+                    if free["s"] is None:
+                        continue
+                    cur = c.execute(
+                        "UPDATE gang SET worker=? WHERE task_id=? AND slot=?"
+                        " AND worker IS NULL",
+                        (worker, r["id"], free["s"]),
+                    )
+                    if cur.rowcount == 1:
+                        task = dict(
+                            c.execute(
+                                "SELECT * FROM tasks WHERE id=?", (r["id"],)
+                            ).fetchone()
+                        )
+                        return {"task": task, "slot": int(free["s"]),
+                                "hosts": int(r["hosts"])}
+            except sqlite3.OperationalError:
+                continue  # concurrent writer won; try the next task
+        return None
+
+    def has_claimable_task(self, free_chips: int) -> bool:
+        """Cheap peek: is any single-host task waiting that would fit?"""
+        row = self._conn.execute(
+            "SELECT 1 FROM tasks WHERE status=? AND hosts=1 AND chips<=?"
+            " LIMIT 1",
+            (TaskStatus.QUEUED.value, free_chips),
+        ).fetchone()
+        return row is not None
+
+    def start_gang_task(self, task_id: int, worker: str) -> bool:
+        """Slot 0 moves the gathered task to IN_PROGRESS under its name, so
+        reap/requeue/finish treat a gang task exactly like any other."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE tasks SET status=?, worker=?, started=?"
+                " WHERE id=? AND status=?",
+                (
+                    TaskStatus.IN_PROGRESS.value,
+                    worker,
+                    time.time(),
+                    task_id,
+                    TaskStatus.QUEUED.value,
+                ),
+            )
+            return cur.rowcount == 1
+
+    def publish_coordinator(self, task_id: int, address: str) -> None:
+        """Slot 0 records the jax.distributed rendezvous address."""
+        with self._tx() as c:
+            c.execute(
+                "UPDATE gang SET coordinator=? WHERE task_id=? AND slot=0",
+                (address, task_id),
+            )
+
+    def gang_state(self, task_id: int) -> Dict[str, Any]:
+        rows = self._conn.execute(
+            "SELECT slot, worker, coordinator FROM gang WHERE task_id=?"
+            " ORDER BY slot",
+            (task_id,),
+        ).fetchall()
+        workers = {int(r["slot"]): r["worker"] for r in rows}
+        return {
+            "workers": workers,
+            "coordinator": rows[0]["coordinator"] if rows else None,
+            "filled": bool(rows) and all(w is not None for w in workers.values()),
+        }
+
+    def release_gang_slot(self, task_id: int, slot: int, worker: str) -> bool:
+        """Give a slot back (gather timed out / task went away)."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE gang SET worker=NULL WHERE task_id=? AND slot=?"
+                " AND worker=?",
+                (task_id, slot, worker),
+            )
+            return cur.rowcount == 1
+
+    def release_worker_gang_slots(self, worker: str) -> int:
+        """Free every gang slot a (dead) worker held — a half-gathered gang
+        must not wait forever on a claimer that will never spawn."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE gang SET worker=NULL WHERE worker=?", (worker,)
+            )
+            return cur.rowcount
 
     def tasks_on_worker(self, worker: str) -> List[Dict[str, Any]]:
         rows = self._conn.execute(

@@ -32,6 +32,10 @@ class ExecutionContext:
     workdir: str = "."
     chips: int = 0             # chips granted to this task
     stage: str = "generic"
+    # False on non-zero slots of a multi-host gang: those processes run
+    # the same SPMD program and would write duplicate metric points; logs
+    # stay on (prefixed by the child runner) for debuggability
+    primary: bool = True
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def log(self, message: str, level: str = "info") -> None:
@@ -39,12 +43,12 @@ class ExecutionContext:
             self.store.log(self.task_id, level, message)
 
     def metric(self, name: str, value: float, step: int = 0) -> None:
-        if self.store is not None:
+        if self.store is not None and self.primary:
             self.store.metric(self.task_id, name, value, step)
 
     def report(self, name: str, payload: Dict[str, Any]) -> None:
         """Persist a report artifact (report/artifacts.py payload)."""
-        if self.store is not None:
+        if self.store is not None and self.primary:
             self.store.add_report(self.task_id, name, payload)
 
 
@@ -89,6 +93,9 @@ def run_task(
     point so scheduling code has exactly one failure boundary.
     """
     try:
+        from mlcomp_tpu.utils.faults import inject
+
+        inject("executor.work")  # chaos hook: die like a real OOM/segv would
         ex = create_executor(type_name, ctx.args)
         result = ex(ctx)
         return True, result, None

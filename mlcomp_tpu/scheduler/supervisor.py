@@ -136,6 +136,9 @@ class Supervisor:
                         dag_id=task["dag_id"],
                         error=f"worker {name!r} died and retries exhausted",
                     )
+            # free any gang slots the dead worker held so a half-gathered
+            # multi-host task can re-gather with live workers
+            self.store.release_worker_gang_slots(name)
             self.store.mark_worker_dead(name)
             self._notify("worker_dead", worker=name)
 

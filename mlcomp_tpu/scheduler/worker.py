@@ -6,9 +6,28 @@ TPU-VM host (or a CPU-only host) advertising some number of TPU chips
 UPDATE in the store, so any number of worker processes can share one queue
 without a lock service.
 
-While an executor runs (minutes to hours for training tasks), a background
-thread keeps heartbeating so the Supervisor's failure detector does not
-reap a healthy-but-busy worker.
+Two execution modes:
+
+- **isolated** (production, ``isolate=True`` / CLI default): each task
+  runs in a child process (scheduler/child.py) with env-pinned chip
+  visibility.  A segfault/OOM/hard-kill inside an executor kills only the
+  child; the worker reaps it into the normal retry machinery.  With
+  enough chips the worker runs several children concurrently, each pinned
+  to its own chip subset, and a task stopped from the CLI/dashboard gets
+  its child killed instead of computing to a discarded finish.
+- **in-process** (``isolate=False``, unit-test default): the executor
+  runs inline — fast, but an executor crash is a worker crash.
+
+Multi-host (``hosts: n``) tasks gang-schedule: this worker claims one
+gang slot (db/store.py ``claim_gang_slot``), slot 0 publishes a
+coordinator address, and once all slots fill each holder spawns its child
+with ``MLCOMP_TPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID`` set — making
+``parallel/distributed.py``'s ``init_distributed`` find a live rendezvous.
+Requires ``isolate`` (each slot needs its own JAX runtime).
+
+While an executor runs (minutes to hours for training tasks), heartbeats
+keep flowing so the Supervisor's failure detector does not reap a
+healthy-but-busy worker.
 """
 
 from __future__ import annotations
@@ -16,9 +35,12 @@ from __future__ import annotations
 import json
 import os
 import socket
+import subprocess
+import sys
+import tempfile
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from mlcomp_tpu.dag.schema import TaskStatus
 from mlcomp_tpu.db.store import Store
@@ -30,27 +52,99 @@ def default_worker_name() -> str:
     return f"{socket.gethostname()}-{os.getpid()}"
 
 
+def sync_code(
+    args: Dict[str, Any], task_id: int, workdir: str, store: Optional[Store]
+) -> None:
+    """Mirror the master's code snapshot (``args["code_src"]``, written by
+    ``io.sync.snapshot_code`` at submit time) into this worker's workdir
+    and make it importable — the reference family's master→worker project
+    sync, hash-incremental here.  Shared by the in-process path and the
+    child runner (scheduler/child.py)."""
+    code_src = args.get("code_src")
+    if not code_src:
+        return
+    from mlcomp_tpu.io.sync import sync_dirs
+
+    dest = os.path.join(workdir, "code")
+    copied, removed = sync_dirs(code_src, dest)
+    if (copied or removed) and store is not None:
+        store.log(
+            task_id,
+            "info",
+            f"code sync: {len(copied)} copied, {len(removed)} removed",
+        )
+    if dest not in sys.path:
+        sys.path.insert(0, dest)
+    # import user modules so their @EXECUTORS.register classes exist;
+    # re-import after a changed sync would need a restart (same rule as
+    # the reference's worker: code changes mid-task are not hot-swapped)
+    import importlib
+
+    for mod in args.get("code_import", []):
+        importlib.import_module(mod)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _host_address() -> str:
+    """Address other hosts can reach this one at (coordinator rendezvous).
+    Env override first (TPU-VM metadata scripts set it); localhost
+    fallback covers single-host and CPU-test topologies."""
+    addr = os.environ.get("MLCOMP_TPU_HOST_IP")
+    if addr:
+        return addr
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
 class Worker:
     def __init__(
         self,
         store: Store,
         name: Optional[str] = None,
         chips: int = 0,
-        hosts: int = 1,
+        hosts: int = 1,  # deprecated: gangs replaced self-declared hosts
         workdir: str = ".",
         heartbeat_interval_s: float = 5.0,
         load_jax_executors: bool = True,
+        isolate: bool = False,
+        max_tasks: Optional[int] = None,
+        gang_wait_s: float = 60.0,
+        child_env: Optional[Dict[str, str]] = None,
     ):
         self.store = store
         self.name = name or default_worker_name()
         self.chips = chips
-        self.hosts = hosts
         self.workdir = workdir
         self.heartbeat_interval_s = heartbeat_interval_s
+        self.isolate = isolate
+        # chips=0 workers (CPU hosts) still run one task at a time unless
+        # told otherwise; chip-ful workers default to chip-packing
+        self.max_tasks = max_tasks if max_tasks is not None else max(1, chips)
+        self.gang_wait_s = gang_wait_s
+        self.child_env = dict(child_env or {})
+        self._free_chip_ids = set(range(chips))
+        self._children: List[Dict[str, Any]] = []
         if load_jax_executors:
             from mlcomp_tpu import executors
 
             executors.load_all()
+
+    def _sync_code(self, args: Dict[str, Any], task_id: int) -> None:
+        sync_code(args, task_id, self.workdir, self.store)
+
+    # ------------------------------------------------------------ heartbeats
 
     def _heartbeat_pump(self, busy_chips: int, stop: threading.Event) -> None:
         """Own-connection heartbeat loop (sqlite connections are per-thread)."""
@@ -61,76 +155,154 @@ class Worker:
         finally:
             hb_store.close()
 
-    def _sync_code(self, args: Dict[str, Any], task_id: int) -> None:
-        """Mirror the master's code snapshot (``args["code_src"]``, written
-        by ``io.sync.snapshot_code`` at submit time) into this worker's
-        workdir and make it importable — the reference family's
-        master→worker project sync, hash-incremental here."""
-        code_src = args.get("code_src")
-        if not code_src:
-            return
-        import sys
+    # --------------------------------------------------------- child plumbing
 
-        from mlcomp_tpu.io.sync import sync_dirs
-
-        dest = os.path.join(self.workdir, "code")
-        copied, removed = sync_dirs(code_src, dest)
-        if copied or removed:
-            self.store.log(
-                task_id,
-                "info",
-                f"code sync: {len(copied)} copied, {len(removed)} removed",
-            )
-        if dest not in sys.path:
-            sys.path.insert(0, dest)
-        # import user modules so their @EXECUTORS.register classes exist;
-        # re-import after a changed sync would need a restart (same rule as
-        # the reference's worker: code changes mid-task are not hot-swapped)
-        import importlib
-
-        for mod in args.get("code_import", []):
-            importlib.import_module(mod)
-
-    def run_once(self) -> bool:
-        """Claim and execute at most one task. Returns True if one ran."""
-        self.store.heartbeat(self.name, self.chips)
-        claim = self.store.claim_task(
-            self.name, free_chips=self.chips, free_hosts=self.hosts
-        )
-        if claim is None:
-            return False
-        inject("worker.after_claim")  # no-op unless a recovery test armed it
-        self.store.heartbeat(self.name, self.chips, busy_chips=claim["chips"])
-        stop = threading.Event()
-        pump = threading.Thread(
-            target=self._heartbeat_pump, args=(claim["chips"], stop), daemon=True
-        )
-        pump.start()
+    def _spawn_child(
+        self, claim: Dict[str, Any], gang: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """Start the task's child process (non-blocking); returns a handle."""
+        chips = int(claim["chips"])
+        ids = sorted(self._free_chip_ids)[:chips]
+        self._free_chip_ids -= set(ids)
         try:
-            # pre-execution setup failures (bad args JSON, code sync/import
-            # errors) must fail THE TASK, not kill the worker loop
-            try:
-                args = json.loads(claim["args"])
-                self._sync_code(args, claim["id"])
-            except Exception:
-                import traceback
+            return self._spawn_child_inner(claim, gang, ids)
+        except Exception:
+            # spawn failures (ENOMEM fork, unwritable workdir) must fail
+            # THE TASK, not kill the worker loop (callers catch and route
+            # into _finalize) — same contract as the in-process setup guard
+            self._free_chip_ids |= set(ids)
+            raise
 
-                ok, result, err = False, None, traceback.format_exc()
-            else:
-                ctx = ExecutionContext(
-                    dag_id=claim["dag_id"],
-                    task_id=claim["id"],
-                    task_name=claim["name"],
-                    args=args,
-                    store=self.store,
-                    workdir=self.workdir,
-                    chips=claim["chips"],
-                    stage=claim["stage"],
-                )
-                ok, result, err = run_task(claim["executor"], ctx)
-        finally:
-            stop.set()
-            pump.join(timeout=self.heartbeat_interval_s + 1.0)
+    def _spawn_child_inner(self, claim, gang, ids) -> Dict[str, Any]:
+        chips = int(claim["chips"])
+        scratch = tempfile.mkdtemp(
+            prefix=f".task-{claim['id']}-", dir=self.workdir
+        )
+        spec_path = os.path.join(scratch, "spec.json")
+        result_path = os.path.join(scratch, "result.json")
+        log_path = os.path.join(scratch, "child.log")
+        spec = {
+            "db": self.store.path,
+            "claim": claim,
+            "workdir": self.workdir,
+            "result": result_path,
+            "process_id": gang["slot"] if gang else 0,
+        }
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        env = dict(os.environ)
+        # the child starts a fresh interpreter with cwd=workdir: make this
+        # very package importable there regardless of how the parent found it
+        import mlcomp_tpu as _pkg
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (pkg_root, env.get("PYTHONPATH")) if p
+        )
+        env["MLCOMP_TPU_CHIP_IDS"] = ",".join(map(str, ids))
+        if ids and chips < self.chips:
+            # pin only when the task takes a strict subset — restricting a
+            # full-host task buys nothing and some runtimes (forwarded
+            # single-chip tunnels) reject visibility filters
+            env["TPU_VISIBLE_DEVICES"] = ",".join(map(str, ids))
+        if gang:
+            env["MLCOMP_TPU_COORDINATOR"] = gang["coordinator"]
+            env["MLCOMP_TPU_NUM_PROCESSES"] = str(gang["hosts"])
+            env["MLCOMP_TPU_PROCESS_ID"] = str(gang["slot"])
+        env.update(self.child_env)
+        log_fh = open(log_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "mlcomp_tpu.scheduler.child", spec_path],
+                env=env,
+                stdout=log_fh,
+                stderr=subprocess.STDOUT,
+                cwd=self.workdir,
+            )
+        except Exception:
+            log_fh.close()
+            raise
+        self.store.log(
+            claim["id"], "info",
+            f"worker {self.name}: spawned child pid {proc.pid}"
+            + (f" (gang slot {gang['slot']}/{gang['hosts']})" if gang else ""),
+        )
+        return {
+            "proc": proc,
+            "claim": claim,
+            "chip_ids": ids,
+            "result": result_path,
+            "log": log_path,
+            "log_fh": log_fh,
+            "scratch": scratch,
+            "gang": gang,
+            "last_status_check": 0.0,
+        }
+
+    def _collect_child(self, child: Dict[str, Any]):
+        """Read the finished child's verdict; free its chips."""
+        rc = child["proc"].wait()
+        child["log_fh"].close()
+        self._free_chip_ids |= set(child["chip_ids"])
+        ok, result, err = False, None, None
+        try:
+            with open(child["result"]) as f:
+                payload = json.load(f)
+            ok, result, err = payload["ok"], payload["result"], payload["error"]
+            if not ok and err is None:
+                err = f"executor failed (child exit {rc})"
+        except (OSError, ValueError):
+            # no/garbled result file: the child died hard (segfault, OOM
+            # kill, fault injection) before writing its verdict
+            tail = b""
+            try:
+                with open(child["log"], "rb") as f:
+                    tail = f.read()[-2000:]
+            except OSError:
+                pass
+            err = (
+                f"task child died (exit code {rc}) before reporting a "
+                f"result; log tail:\n{tail.decode(errors='replace')}"
+            )
+        if not os.environ.get("MLCOMP_TPU_KEEP_CHILD_SCRATCH"):
+            import shutil
+
+            shutil.rmtree(child["scratch"], ignore_errors=True)
+        return ok, result, err
+
+    def _kill_child(self, child: Dict[str, Any], reason: str) -> None:
+        self.store.log(child["claim"]["id"], "warning",
+                       f"worker {self.name}: killing child ({reason})")
+        child["proc"].terminate()
+        try:
+            child["proc"].wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            child["proc"].kill()
+
+    def _task_still_mine(self, child: Dict[str, Any]) -> bool:
+        """False once the task was stopped or reaped away from this gang/
+        worker — the child should be killed, not raced against."""
+        row = self.store.task_row(child["claim"]["id"])
+        if row is None or row["status"] != TaskStatus.IN_PROGRESS.value:
+            return False
+        gang = child["gang"]
+        owner = row["worker"]
+        if gang is None or gang["slot"] == 0:
+            return owner == self.name
+        # slot>0: the row is owned by slot 0's worker, but a requeue +
+        # re-gather can put the task back IN_PROGRESS under a NEW gang —
+        # this child is stale unless its slot is still ours
+        state = self.store.gang_state(child["claim"]["id"])
+        return state["workers"].get(gang["slot"]) == self.name
+
+    def _finalize(self, claim, ok, result, err, gang=None) -> None:
+        """Route the outcome into the store (single-host and gang slot 0).
+
+        Non-zero gang slots own nothing: their failures reach the log via
+        the child, and the task row is settled by slot 0 (or the reaper
+        if slot 0's worker died)."""
+        if gang is not None and gang["slot"] != 0:
+            return
         inject("worker.before_finish")  # executor done, result not yet stored
         # expect_worker guards against a reaped-and-requeued task being
         # clobbered by this (stale) worker finishing late.
@@ -152,10 +324,217 @@ class Worker:
                     error=err,
                     expect_worker=self.name,
                 )
+
+    def _wait_child(self, child: Dict[str, Any]):
+        """Blocking wait with a stop-watch: a task stopped from the CLI or
+        dashboard kills the child instead of letting it run to a discarded
+        finish."""
+        while child["proc"].poll() is None:
+            time.sleep(0.25)
+            now = time.time()
+            if now - child["last_status_check"] >= 2.0:
+                child["last_status_check"] = now
+                if not self._task_still_mine(child):
+                    self._kill_child(child, "task stopped or reassigned")
+        return self._collect_child(child)
+
+    # ------------------------------------------------------------- in-process
+
+    def _run_inline(self, claim: Dict[str, Any]):
+        # pre-execution setup failures (bad args JSON, code sync/import
+        # errors) must fail THE TASK, not kill the worker loop
+        try:
+            args = json.loads(claim["args"])
+            sync_code(args, claim["id"], self.workdir, self.store)
+        except Exception:
+            import traceback
+
+            return False, None, traceback.format_exc()
+        ctx = ExecutionContext(
+            dag_id=claim["dag_id"],
+            task_id=claim["id"],
+            task_name=claim["name"],
+            args=args,
+            store=self.store,
+            workdir=self.workdir,
+            chips=claim["chips"],
+            stage=claim["stage"],
+        )
+        return run_task(claim["executor"], ctx)
+
+    # ------------------------------------------------------------- gang claims
+
+    def _gather_gang(self) -> Optional[Dict[str, Any]]:
+        """Claim a slot of a multi-host task and wait for the gang to fill.
+
+        Returns {"claim": task_row, "gang": {...}} ready to spawn, or None
+        (nothing to gang / gather timed out / task went away — the slot is
+        released in those cases)."""
+        slot_claim = self.store.claim_gang_slot(self.name, free_chips=self.chips)
+        if slot_claim is None:
+            return None
+        task, slot, hosts = (
+            slot_claim["task"], slot_claim["slot"], slot_claim["hosts"]
+        )
+        tid = task["id"]
+        if slot == 0:
+            self.store.publish_coordinator(
+                tid, f"{_host_address()}:{_free_port()}"
+            )
+        def ready(state, row):
+            gang = {
+                "slot": slot,
+                "hosts": hosts,
+                "coordinator": state["coordinator"],
+            }
+            return {"claim": row, "gang": gang}
+
+        t_start = time.time()
+        deadline = t_start + self.gang_wait_s
+        while time.time() < deadline:
+            row = self.store.task_row(tid)
+            if row is None or row["status"] not in (
+                TaskStatus.QUEUED.value, TaskStatus.IN_PROGRESS.value
+            ):
+                break  # stopped / reaped away mid-gather
+            state = self.store.gang_state(tid)
+            if state["workers"].get(slot) != self.name:
+                return None  # slot was reaped from under us; nothing to release
+            if state["filled"] and state["coordinator"]:
+                if slot == 0:
+                    if row["status"] == TaskStatus.QUEUED.value and (
+                        not self.store.start_gang_task(tid, self.name)
+                    ):
+                        break  # lost to a stop; release below
+                elif row["status"] != TaskStatus.IN_PROGRESS.value:
+                    # wait for slot 0 to flip the task
+                    self.store.heartbeat(self.name, self.chips)
+                    time.sleep(0.2)
+                    continue
+                return ready(state, self.store.task_row(tid))
+            if (
+                time.time() - t_start > 10.0
+                and self.store.has_claimable_task(self.chips)
+            ):
+                # the gang had a fair gather window and still isn't full
+                # while runnable single-host work waits — don't starve it
+                # behind a gang that may never fill; bail and come back
+                break
+            self.store.heartbeat(self.name, self.chips)
+            time.sleep(0.2)
+        # deadline/bail: the gang may have completed in the race window —
+        # a slot holder walking away from an IN_PROGRESS gang would strand
+        # slot 0's child waiting on a process that never comes
+        row = self.store.task_row(tid)
+        state = self.store.gang_state(tid)
+        if (
+            row is not None
+            and row["status"] == TaskStatus.IN_PROGRESS.value
+            and state["workers"].get(slot) == self.name
+            and state["filled"]
+            and state["coordinator"]
+        ):
+            return ready(state, row)
+        self.store.release_gang_slot(tid, slot, self.name)
+        return None
+
+    # ------------------------------------------------------------- main loops
+
+    def run_once(self) -> bool:
+        """Claim and execute at most one task (blocking). True if one ran."""
+        self.store.heartbeat(self.name, self.chips)
+        claim = self.store.claim_task(self.name, free_chips=self.chips)
+        gang = None
+        if claim is None and self.isolate:
+            gathered = self._gather_gang()
+            if gathered is None:
+                return False
+            claim, gang = gathered["claim"], gathered["gang"]
+        if claim is None:
+            return False
+        inject("worker.after_claim")  # no-op unless a recovery test armed it
+        self.store.heartbeat(self.name, self.chips, busy_chips=claim["chips"])
+        stop = threading.Event()
+        pump = threading.Thread(
+            target=self._heartbeat_pump, args=(claim["chips"], stop), daemon=True
+        )
+        pump.start()
+        try:
+            if self.isolate:
+                try:
+                    child = self._spawn_child(claim, gang=gang)
+                except Exception:
+                    import traceback
+
+                    ok, result, err = False, None, traceback.format_exc()
+                else:
+                    ok, result, err = self._wait_child(child)
+            else:
+                ok, result, err = self._run_inline(claim)
+        finally:
+            stop.set()
+            pump.join(timeout=self.heartbeat_interval_s + 1.0)
+        self._finalize(claim, ok, result, err, gang=gang)
         self.store.heartbeat(self.name, self.chips, busy_chips=0)
         return True
 
+    def _try_spawn(self, claim, gang) -> bool:
+        """Spawn into the children pool; a spawn failure fails the task."""
+        try:
+            self._children.append(self._spawn_child(claim, gang=gang))
+            return True
+        except Exception:
+            import traceback
+
+            self._finalize(claim, False, None, traceback.format_exc(),
+                           gang=gang)
+            return False
+
+    def poll(self) -> bool:
+        """One non-blocking scheduling step (isolated mode): reap finished
+        children, kill stopped ones, then claim/spawn up to capacity.
+        Returns True if anything progressed."""
+        progressed = False
+        for child in list(self._children):
+            if child["proc"].poll() is not None:
+                self._children.remove(child)
+                ok, result, err = self._collect_child(child)
+                self._finalize(
+                    child["claim"], ok, result, err, gang=child["gang"]
+                )
+                progressed = True
+                continue
+            now = time.time()
+            if now - child["last_status_check"] >= 2.0:
+                child["last_status_check"] = now
+                if not self._task_still_mine(child):
+                    self._kill_child(child, "task stopped or reassigned")
+        busy = sum(int(c["claim"]["chips"]) for c in self._children)
+        while len(self._children) < self.max_tasks:
+            claim = self.store.claim_task(
+                self.name, free_chips=self.chips - busy
+            )
+            if claim is None:
+                break
+            progressed = True
+            if self._try_spawn(claim, None):
+                busy += int(claim["chips"])
+        if not self._children:
+            # idle: offer this worker to a multi-host gang (the gather wait
+            # blocks this loop for at most gang_wait_s)
+            gathered = self._gather_gang()
+            if gathered is not None:
+                progressed = True
+                if self._try_spawn(gathered["claim"], gathered["gang"]):
+                    busy = int(gathered["claim"]["chips"])
+        self.store.heartbeat(self.name, self.chips, busy_chips=busy)
+        return progressed
+
     def run_forever(self, poll_interval: float = 0.5) -> None:
+        if not self.isolate:
+            while True:
+                if not self.run_once():
+                    time.sleep(poll_interval)
         while True:
-            if not self.run_once():
+            if not self.poll():
                 time.sleep(poll_interval)

@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# The TPU-VM image's sitecustomize registers the axon TPU plugin in EVERY
+# python process whose env carries this trigger — including the worker
+# child processes tests spawn (scheduler/child.py), where a half-registered
+# TPU backend breaks CPU jax.distributed.  Tests are CPU-only by contract;
+# strip the trigger so children start clean.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import pytest  # noqa: E402
 
