@@ -707,6 +707,19 @@ class Store:
             )
             return cur.rowcount == 1
 
+    def broken_gang_tasks(self) -> List[Dict[str, Any]]:
+        """IN_PROGRESS gang tasks with an unheld slot: a member died after
+        launch.  The remaining children are blocked in collectives against
+        a peer that will never return, so the whole task must be requeued
+        (a running gang cannot be rejoined — claim_gang_slot only matches
+        queued tasks)."""
+        rows = self._conn.execute(
+            "SELECT DISTINCT t.* FROM tasks t JOIN gang g ON g.task_id=t.id"
+            " WHERE g.worker IS NULL AND t.status=?",
+            (TaskStatus.IN_PROGRESS.value,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
     def release_worker_gang_slots(self, worker: str) -> int:
         """Free every gang slot a (dead) worker held — a half-gathered gang
         must not wait forever on a claimer that will never spawn."""

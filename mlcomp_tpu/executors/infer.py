@@ -311,7 +311,9 @@ class GenerateExecutor(Executor):
         # positions.  Opt out (`mask_prompt_padding: false`) only for
         # fixed-length unpadded prompt sets.
         mask_padding = bool(cfg.pop("mask_prompt_padding", True))
-        quantize = bool(cfg.pop("quantize", False))
+        # False | True/"int8" (storage quant, entry dequant) | "kernel"
+        # (int8 consumed directly by the Pallas matmul during decode)
+        quantize = cfg.pop("quantize", False)
         # opt-in decode-time weight pre-cast (weights are read once per
         # token; bf16 is a measured ~1.4x decode win over fp32 masters,
         # at some weight-precision cost on fp32-compute heads)
@@ -330,7 +332,14 @@ class GenerateExecutor(Executor):
             variables = {
                 **variables, "params": quantize_params(variables["params"])
             }
-            ctx.log("int8 weight-only quantization enabled for decoding")
+            if str(quantize).lower() == "kernel":
+                # consume int8 directly in the Pallas matmul (half the
+                # decode weight read) instead of dequantizing at entry
+                knobs["quant_kernel"] = True
+            ctx.log(
+                "int8 weight-only quantization enabled for decoding"
+                + (" (Pallas kernel path)" if knobs.get("quant_kernel") else "")
+            )
         gen_fn = jax.jit(partial(generate, trainer.model, **knobs))
         outs = []
         rng = jax.random.PRNGKey(seed)

@@ -114,6 +114,7 @@ def generate(
     pad_id: int = 0,
     rng: Optional[jax.Array] = None,
     weights_dtype=None,
+    quant_kernel: bool = False,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
@@ -143,22 +144,45 @@ def generate(
     cache = init_cache(model, b, total)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    # Decode reads every weight once per token, so weight bytes ARE the
-    # step time.  Measured on a v5e (200M-param LM, batch 4): fp32 master
-    # weights 25 tok/s, pre-cast bf16 35 tok/s (the 1.4× ``weights_dtype``
-    # buys — the executor passes the model's compute dtype), int8 ~25
-    # tok/s even with entry dequant + optimization_barrier (XLA still
-    # re-reads through the dequant chain in the scan).  int8 therefore
-    # stands as storage/transfer compression; a Pallas int8 GEMV kernel
-    # is the upgrade path if it must also be a bandwidth win.
+    # Decode reads every weight once per token, so weight bytes bound the
+    # step time.  Two int8 modes:
+    # - default (storage): dequantize ONCE at entry, decode runs bf16.
+    #   In-scan jnp dequant was measured SLOWER than bf16 (XLA
+    #   materializes the dequantized copy per token).
+    # - ``quant_kernel=True``: keep kernel-consumable 2-D leaves int8 and
+    #   route their Dense/Embed ops through the Pallas int8 matmul
+    #   (ops/pallas/quant_matmul.py) — the dequant happens in VMEM, so
+    #   those weights (mlp + lm_head + embed ≈ 80% of a decoder's bytes)
+    #   cost HALF the HBM read per token.  3-D attention projections
+    #   still dequantize at entry (their per-channel scales don't factor
+    #   out of the contraction).
+    # Measured (v5e, 268M LM, B=4, 128 new tokens, interleaved medians):
+    # bf16 pre-cast 1.74 ms/tok, int8 entry-dequant 1.63, int8 kernel
+    # 1.61 — the kernel wins, but modestly: at this size the step is only
+    # ~40% weight reads (attention over the cache, the fp32 logits head,
+    # and per-op overheads make up the rest).  At B=1 the Pallas per-call
+    # overhead outweighs the read saving (bf16 wins); the kernel path is
+    # the right default for batched serving, not single-stream.
+    use_quant_kernel = False
     if has_quantized(variables):
-        variables = dequantize_params(
-            variables, weights_dtype if weights_dtype is not None else jnp.bfloat16
-        )
-        # without the barrier XLA re-runs the (cheap-looking) dequant
-        # inside every scan iteration, re-reading the int8 AND writing
-        # bf16 per token — the barrier pins one materialized copy
-        variables = jax.lax.optimization_barrier(variables)
+        if quant_kernel:
+            from mlcomp_tpu.ops.quant import dequantize_nonkernel_params
+
+            use_quant_kernel = True
+            variables = dequantize_nonkernel_params(
+                variables,
+                weights_dtype if weights_dtype is not None else jnp.bfloat16,
+            )
+            variables = jax.lax.optimization_barrier(variables)
+        else:
+            variables = dequantize_params(
+                variables,
+                weights_dtype if weights_dtype is not None else jnp.bfloat16,
+            )
+            # without the barrier XLA re-runs the (cheap-looking) dequant
+            # inside every scan iteration, re-reading the int8 AND writing
+            # bf16 per token — the barrier pins one materialized copy
+            variables = jax.lax.optimization_barrier(variables)
     elif weights_dtype is not None:
         # same eligibility rule as quantize_params: only big matrices.
         # 1D leaves (RMSNorm scales — fp32 by design) and small tensors
@@ -180,6 +204,14 @@ def generate(
     def model_vars(cache):
         return {**fixed, "cache": cache}
 
+    def apply_model(*args, **kwargs):
+        if use_quant_kernel:
+            from mlcomp_tpu.ops.quant import quant_kernel_interception
+
+            with quant_kernel_interception():
+                return model.apply(*args, **kwargs)
+        return model.apply(*args, **kwargs)
+
     if prompt_mask is not None:
         pm = prompt_mask.astype(jnp.bool_)
         positions = jnp.maximum(jnp.cumsum(pm, axis=1) - 1, 0).astype(jnp.int32)
@@ -192,7 +224,7 @@ def generate(
         real_len = jnp.full((b,), s, jnp.int32)
         kv_mask = None
 
-    logits, updated = model.apply(
+    logits, updated = apply_model(
         model_vars(cache),
         prompt,
         decode=True,
@@ -214,7 +246,7 @@ def generate(
         cache, last_logits, done, pos, rng = carry
         rng, sub = jax.random.split(rng)
         tok, done = next_token(sub, last_logits, done)
-        logits, updated = model.apply(
+        logits, updated = apply_model(
             model_vars(cache),
             tok[:, None],
             decode=True,

@@ -1,0 +1,130 @@
+"""Pallas TPU int8 weight-only matmul: dequantize in VMEM, not in HBM.
+
+Decode is weight-bandwidth-bound: every generated token re-reads every
+weight matrix once while activations are tiny (B rows).  Storing weights
+int8 halves the HBM traffic — but only if the dequantize happens INSIDE
+the kernel, after the int8 block is already in VMEM.  XLA cannot do this
+with a jnp ``q.astype(bf16) * scale`` prefix: it materializes the
+dequantized copy in HBM once per scan step (measured slower than plain
+bf16 in round 1, models/generation.py).  This kernel is that missing
+fusion:
+
+    out[B, N] = (x[B, D] @ q8[D, N]) * scale[N]
+
+- per-output-channel scales commute with the contraction, so the scale
+  multiply happens once on the (B, N) accumulator, not on the (D, N)
+  weights;
+- q8 blocks upcast int8→bf16 in registers/VMEM; the MXU runs a normal
+  bf16 matmul (x is bf16);
+- grid (N blocks, D blocks), D innermost: fp32 accumulator scratch
+  carries across D steps (same pattern as the flash kernel);
+- B is padded to the 8-sublane minimum; decode batches are small, the
+  padding rows are sliced off at the wrapper.
+
+The same kernel serves stacked per-layer weights via vmap at the caller
+(scales are per-(layer, channel) after ops/quant.py's stacked-axis fix).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SUBLANES = 8
+LANES = 128
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, block_d, out_dtype):
+    j = pl.program_id(2)
+    nd = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:]                                   # (Bp, BD) bf16
+    q = q_ref[:].astype(x.dtype)                   # int8 -> bf16 in VMEM
+    acc_ref[:] += jax.lax.dot_general(
+        x, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == nd - 1)
+    def _finalize():
+        # s_ref is the (8, BN) broadcast tile; row 0 carries the data
+        o_ref[:] = (acc_ref[:] * s_ref[0:1]).astype(out_dtype)
+
+
+def quant_matmul(
+    x: jax.Array,
+    q8: jax.Array,
+    scale: jax.Array,
+    block_n: int = 512,
+    block_d: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x @ (q8 * scale)`` with the dequant fused into the kernel.
+
+    x: (B, D) float (bf16/f32); q8: (D, N) int8; scale: (D-broadcastable,
+    N) or (N,) float — per-output-channel.  Returns (B, N) in x.dtype.
+    Falls back (NotImplementedError) when D or N don't tile; the caller
+    (ops/quant.py dispatch) keeps the XLA path for those.
+    """
+    b, d = x.shape
+    d2, n = q8.shape
+    if d != d2:
+        raise ValueError(f"contraction mismatch: x {x.shape} vs q8 {q8.shape}")
+    scale = scale.reshape(-1)[-n:] if scale.size == n else scale
+    if scale.shape != (n,):
+        raise ValueError(f"scale must be ({n},); got {scale.shape}")
+    # largest preferred block that divides the dim — the SAME rule
+    # kernel_consumable (ops/quant.py) checks against, so anything it
+    # admits tiles here (any lane multiple works via the 128 fallback)
+    block_d = _fit_block(d, block_d)
+    block_n = _fit_block(n, block_n)
+    if block_d is None or block_n is None:
+        raise NotImplementedError(
+            f"shapes must tile into lane multiples: D={d}, N={n}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    # tile the row axis too: interception covers the PREFILL pass, where
+    # rows = B*S can be thousands — an untiled row axis would put a
+    # rows x block_n fp32 accumulator in VMEM
+    bp = max(SUBLANES, -(-b // SUBLANES) * SUBLANES)
+    block_b = min(256, bp)
+    bp = -(-bp // block_b) * block_b
+    if bp != b:
+        x = jnp.pad(x, ((0, bp - b), (0, 0)))
+    # scale rides as an (8, N) broadcast so its block meets the TPU
+    # (8, 128) min tile; row 0 is the real data
+    s2 = jnp.broadcast_to(scale.astype(jnp.float32)[None, :], (SUBLANES, n))
+
+    kernel = functools.partial(
+        _kernel, block_d=block_d, out_dtype=x.dtype
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bp // block_b, n // block_n, d // block_d),
+        in_specs=[
+            pl.BlockSpec((block_b, block_d), lambda r, i, j: (r, j)),
+            pl.BlockSpec((block_d, block_n), lambda r, i, j: (j, i)),
+            pl.BlockSpec((SUBLANES, block_n), lambda r, i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((block_b, block_n), lambda r, i, j: (r, i)),
+        out_shape=jax.ShapeDtypeStruct((bp, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_b, block_n), jnp.float32)],
+        interpret=interpret,
+    )(x, q8, s2)
+    return out[:b]
+
+
+def _fit_block(dim: int, preferred: int):
+    for blk in (preferred, 512, 256, LANES):
+        if blk <= preferred and dim % blk == 0:
+            return blk
+    return None

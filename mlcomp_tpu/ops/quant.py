@@ -88,6 +88,120 @@ def dequantize_params(params, dtype=jnp.bfloat16):
     )
 
 
+def kernel_consumable(leaf: Dict[str, jax.Array]) -> bool:
+    """True if the Pallas int8 matmul can consume this leaf directly:
+    2-D kernel, lane-tileable, with the scale constant along the
+    contraction axis (quantize_leaf's axis ``ndim-2`` reduce puts 2-D
+    scales on the output channel — exactly the factorable case).  3-D+
+    kernels (DenseGeneral attention projections, stacked layer params)
+    fall back to entry dequantization."""
+    q = leaf[_QKEY]
+    return (
+        q.ndim == 2 and q.shape[0] % 128 == 0 and q.shape[1] % 128 == 0
+    )
+
+
+def dequantize_nonkernel_params(params, dtype=jnp.bfloat16):
+    """Dequantize every quantized leaf EXCEPT the ones
+    :func:`quant_kernel_interception` will consume, selected by the same
+    rule the interceptor dispatches on — flax param naming:
+
+    - ``.../kernel`` with a tileable 2-D q8 (nn.Dense, and DenseGeneral
+      with a single contraction axis) → stays int8 for the matmul kernel;
+    - ``.../embedding`` (nn.Embed) → stays int8 for the gather path,
+      which is shape-agnostic (no tiling requirement);
+    - anything else (3-D attention projections, custom modules' params)
+      → dequantized here, so ``model.apply`` never meets a {"q8", ...}
+      dict it doesn't understand.
+
+    A custom non-Dense module whose 2-D param happens to be NAMED
+    ``kernel`` is the one unsupported corner (it would stay int8 but not
+    be intercepted) — name params differently or skip ``quant_kernel``
+    for such models."""
+    from jax.tree_util import tree_map_with_path
+
+    def visit(path, leaf):
+        if not is_quantized_leaf(leaf):
+            return leaf
+        key = getattr(path[-1], "key", None) if path else None
+        if key == "embedding":
+            return leaf
+        if key == "kernel" and kernel_consumable(leaf):
+            return leaf
+        return dequantize_leaf(leaf, dtype)
+
+    return tree_map_with_path(visit, params, is_leaf=is_quantized_leaf)
+
+
+def quant_kernel_interception():
+    """Flax interception context: while active, ``nn.Dense`` / ``nn.Embed``
+    modules whose parameter is an int8-quantized leaf compute through the
+    Pallas kernel (ops/pallas/quant_matmul.py) instead of crashing on the
+    {"q8", "q8_scale"} dict.  Works on ANY model without model changes —
+    the module tree is intercepted at apply time, so MoE and custom user
+    models get the fast path for free wherever they use plain Dense/Embed.
+
+    Dense: ``out = quant_matmul(x, q8, scale)`` — dequant fused in VMEM,
+    halving the decode-critical HBM weight read.  The matmul runs in
+    bf16 with fp32 accumulation even for fp32-compute modules (lm_head):
+    that mantissa trade is inherent to int8 weights anyway.
+    Embed: gather rows of q8 then scale (per-column scales are shared by
+    every row, so the gather commutes with dequantization).
+    """
+    from flax import linen as nn
+
+    from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+
+    def dense_like(mod):
+        if type(mod) is nn.Dense:
+            return True
+        if type(mod) is nn.DenseGeneral:
+            # a single trailing contraction axis and no batch dims is
+            # exactly Dense semantics (2-D kernel, features last)
+            axis = mod.axis if isinstance(mod.axis, tuple) else (mod.axis,)
+            batch = (
+                mod.batch_dims if isinstance(mod.batch_dims, tuple)
+                else (mod.batch_dims,)
+            )
+            return axis == (-1,) and batch == ()
+        return False
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if context.method_name != "__call__":
+            return next_fun(*args, **kwargs)
+        if dense_like(mod) and mod.has_variable("params", "kernel"):
+            k = mod.get_variable("params", "kernel")
+            if is_quantized_leaf(k) and k[_QKEY].ndim == 2:
+                x = args[0]
+                out_dtype = mod.dtype or x.dtype
+                if kernel_consumable(k):
+                    xs = x.shape
+                    x2 = x.reshape(-1, xs[-1]).astype(jnp.bfloat16)
+                    out = quant_matmul(
+                        x2, k[_QKEY], k[_SKEY].reshape(-1)
+                    ).astype(out_dtype).reshape(*xs[:-1], -1)
+                else:  # odd shape: dequantize inline, still correct
+                    out = (
+                        x.astype(out_dtype)
+                        @ dequantize_leaf(k, out_dtype)
+                    )
+                if mod.use_bias:
+                    bias = mod.get_variable("params", "bias")
+                    out = out + bias.astype(out_dtype)
+                return out
+        if type(mod) is nn.Embed and mod.has_variable("params", "embedding"):
+            e = mod.get_variable("params", "embedding")
+            if is_quantized_leaf(e):
+                ids = args[0]
+                out_dtype = mod.dtype or jnp.float32
+                rows = jnp.take(e[_QKEY], ids, axis=0).astype(jnp.float32)
+                return (rows * e[_SKEY].reshape(-1)).astype(out_dtype)
+        return next_fun(*args, **kwargs)
+
+    return nn.intercept_methods(interceptor)
+
+
 def has_quantized(params) -> bool:
     found = [False]
 

@@ -141,6 +141,25 @@ class Supervisor:
             self.store.release_worker_gang_slots(name)
             self.store.mark_worker_dead(name)
             self._notify("worker_dead", worker=name)
+        # a dead gang MEMBER (slot>0) doesn't own the task row, so the
+        # per-worker loop above misses it: its surviving peers are wedged
+        # in collectives against a vanished process — requeue the task
+        # (which clears the gang; the stop-watch in the surviving workers
+        # then kills their children)
+        for task in self.store.broken_gang_tasks():
+            if not self.store.requeue_task(task["id"]):
+                if self.store.finish_task(
+                    task["id"],
+                    TaskStatus.FAILED,
+                    error="gang member died and retries exhausted",
+                ):
+                    self._notify(
+                        "task_failed",
+                        task_id=task["id"],
+                        task=task["name"],
+                        dag_id=task["dag_id"],
+                        error="gang member died and retries exhausted",
+                    )
 
     def run_forever(self, poll_interval: float = 1.0) -> None:
         while True:

@@ -92,6 +92,27 @@ def test_gang_cleared_on_requeue_and_stop(store):
     assert store.gang_state(tid)["workers"] == {}
 
 
+def test_dead_gang_member_requeues_running_task(store):
+    """A slot>0 worker dying AFTER launch wedges the survivors in
+    collectives; the reaper must requeue the whole gang task."""
+    from mlcomp_tpu.scheduler.supervisor import Supervisor
+
+    _, tid = _submit_gang_task(store, hosts=2, max_retries=1)
+    store.heartbeat("w-live", chips=0)
+    store.claim_gang_slot("w-live", free_chips=0)   # slot 0
+    store.heartbeat("w-dead", chips=0)
+    store.claim_gang_slot("w-dead", free_chips=0)   # slot 1
+    assert store.start_gang_task(tid, "w-live")
+    # w-dead stops heartbeating; w-live stays alive
+    time.sleep(0.06)
+    store.heartbeat("w-live", chips=0)
+    sup = Supervisor(store, worker_timeout_s=0.05)
+    sup.tick()
+    row = store.task_row(tid)
+    assert row["status"] == TaskStatus.QUEUED.value   # requeued, retry spent
+    assert store.gang_state(tid)["workers"] == {}     # fresh gather
+
+
 def test_dead_worker_gang_slots_released(store):
     """Supervisor reap frees slots held by heartbeat-dead workers so a
     half-gathered gang can re-gather."""
