@@ -329,16 +329,25 @@ class GenerateExecutor(Executor):
         if quantize:
             from mlcomp_tpu.ops.quant import quantize_params
 
+            mode = (
+                "int8" if quantize is True else str(quantize).strip().lower()
+            )
+            if mode not in ("int8", "kernel"):
+                # a typo must not silently degrade to the wrong perf mode
+                raise ValueError(
+                    f"quantize: expected true/'int8' or 'kernel', got "
+                    f"{quantize!r}"
+                )
             variables = {
                 **variables, "params": quantize_params(variables["params"])
             }
-            if str(quantize).lower() == "kernel":
+            if mode == "kernel":
                 # consume int8 directly in the Pallas matmul (half the
                 # decode weight read) instead of dequantizing at entry
                 knobs["quant_kernel"] = True
             ctx.log(
                 "int8 weight-only quantization enabled for decoding"
-                + (" (Pallas kernel path)" if knobs.get("quant_kernel") else "")
+                + (" (Pallas kernel path)" if mode == "kernel" else "")
             )
         gen_fn = jax.jit(partial(generate, trainer.model, **knobs))
         outs = []

@@ -165,24 +165,19 @@ def generate(
     # the right default for batched serving, not single-stream.
     use_quant_kernel = False
     if has_quantized(variables):
-        if quant_kernel:
-            from mlcomp_tpu.ops.quant import dequantize_nonkernel_params
+        from mlcomp_tpu.ops.quant import dequantize_nonkernel_params
 
-            use_quant_kernel = True
-            variables = dequantize_nonkernel_params(
+        use_quant_kernel = bool(quant_kernel)
+        deq = dequantize_nonkernel_params if quant_kernel else dequantize_params
+        # without the barrier XLA re-runs the (cheap-looking) dequant
+        # inside every scan iteration, re-reading the int8 AND writing
+        # bf16 per token — the barrier pins one materialized copy
+        variables = jax.lax.optimization_barrier(
+            deq(
                 variables,
                 weights_dtype if weights_dtype is not None else jnp.bfloat16,
             )
-            variables = jax.lax.optimization_barrier(variables)
-        else:
-            variables = dequantize_params(
-                variables,
-                weights_dtype if weights_dtype is not None else jnp.bfloat16,
-            )
-            # without the barrier XLA re-runs the (cheap-looking) dequant
-            # inside every scan iteration, re-reading the int8 AND writing
-            # bf16 per token — the barrier pins one materialized copy
-            variables = jax.lax.optimization_barrier(variables)
+        )
     elif weights_dtype is not None:
         # same eligibility rule as quantize_params: only big matrices.
         # 1D leaves (RMSNorm scales — fp32 by design) and small tensors

@@ -22,13 +22,18 @@ Design notes (TPU-first):
   params run through a sequential ``lax.scan`` — one parameter layout,
   two execution schedules, and the scan path doubles as the numerics
   reference for the pipelined one;
-- params stay NETWORK-ordered so checkpoints are portable across mesh
-  shapes (device order would bake in one pp size).  The price: the
-  interleaved configs (``layers > pp``) pay a per-step weight
-  permutation across pp shards inside ``pipeline_apply``; ``layers ==
-  pp`` (plain GPipe) is permutation-free.  A fixed-stage device-ordered
-  layout (``pre_interleaved=True``) is the future optimization if the
-  trunk-weight traffic ever dominates.
+- param-stack ordering is a config choice.  Default (``device_ordered_pp
+  = 0``): NETWORK order — checkpoints portable across mesh shapes, but
+  interleaved configs (``layers > pp``) pay a per-step cross-shard
+  weight permutation inside ``pipeline_apply``.  Production
+  (``device_ordered_pp = <pp>``): the stack is stored DEVICE-ordered for
+  that pp size, so each device's P("pp") shard already holds its
+  lap-ordered virtual stages and the per-step permutation disappears
+  from the lowered HLO entirely.  The sequential fallback un-permutes
+  (off the hot path), and apply on a mismatched pp raises instead of
+  silently mis-ordering layers; converting a device-ordered checkpoint
+  back to portable network order is
+  ``parallel.pipeline.deinterleave_stage_params``.
 
 The per-layer math mirrors models/transformer.py's DecoderLayer (RMSNorm
 pre-norm, RoPE, GQA attention, SwiGLU) in functional form, so parity
@@ -83,6 +88,9 @@ class PipelinedTransformerLM(nn.Module):
     # fills the ring).  More microbatches shrink the relative bubble.
     n_microbatches: int = 0
     remat: bool = True
+    # 0 = network-ordered stacks (portable, per-step permutation when
+    # layers > pp); N = device-ordered for pp=N (permutation-free)
+    device_ordered_pp: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -122,6 +130,20 @@ class PipelinedTransformerLM(nn.Module):
         pp = axis_size(mesh, "pp")
         if pp > 1 and self.layers % pp:
             raise ValueError(f"{self.layers} layers not a multiple of pp={pp}")
+        if self.device_ordered_pp:
+            if self.layers % self.device_ordered_pp:
+                raise ValueError(
+                    f"{self.layers} layers not a multiple of "
+                    f"device_ordered_pp={self.device_ordered_pp}"
+                )
+            if pp > 1 and pp != self.device_ordered_pp:
+                # a device-ordered stack on the wrong pp would silently run
+                # the layers in the wrong order — refuse
+                raise ValueError(
+                    f"params are device-ordered for pp={self.device_ordered_pp} "
+                    f"but the mesh has pp={pp}; convert with "
+                    "parallel.pipeline.deinterleave_stage_params"
+                )
         # init traces with a 1-row sample batch that can't be microbatched;
         # the scan path creates identical param shapes
         if pp > 1 and not self.is_initializing():
@@ -142,11 +164,20 @@ class PipelinedTransformerLM(nn.Module):
                 n_micro,
                 mesh,
                 remat=self.remat,
+                pre_interleaved=bool(self.device_ordered_pp),
                 data_axes=("dp", "fsdp"),
             )
         else:
             # no pp axis: run the same stacked params sequentially — the
             # schedule-free reference path (tests compare against this)
+            if self.device_ordered_pp:
+                from mlcomp_tpu.parallel.pipeline import (
+                    deinterleave_stage_params,
+                )
+
+                stages = deinterleave_stage_params(
+                    stages, self.device_ordered_pp
+                )
             body = jax.checkpoint(stage_fn) if self.remat else stage_fn
             h, _ = jax.lax.scan(
                 lambda carry, p: (body(p, carry), None), h, stages

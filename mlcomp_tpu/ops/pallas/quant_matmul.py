@@ -38,7 +38,7 @@ SUBLANES = 8
 LANES = 128
 
 
-def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, block_d, out_dtype):
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, out_dtype):
     j = pl.program_id(2)
     nd = pl.num_programs(2)
 
@@ -104,9 +104,7 @@ def quant_matmul(
     # (8, 128) min tile; row 0 is the real data
     s2 = jnp.broadcast_to(scale.astype(jnp.float32)[None, :], (SUBLANES, n))
 
-    kernel = functools.partial(
-        _kernel, block_d=block_d, out_dtype=x.dtype
-    )
+    kernel = functools.partial(_kernel, out_dtype=x.dtype)
     out = pl.pallas_call(
         kernel,
         grid=(bp // block_b, n // block_n, d // block_d),

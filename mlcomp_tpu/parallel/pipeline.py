@@ -167,9 +167,9 @@ def pipeline_apply(
         # round-robin virtual-stage assignment: device d owns k*S + d, so
         # reorder the stack to [d*v + k] -> k*S + d before P(pp) sharding.
         # This gather runs INSIDE the step (params are step inputs XLA
-        # cannot hoist over); training loops should store params
-        # device-ordered via interleave_stage_params and pass
-        # pre_interleaved=True so the per-step copy disappears.
+        # cannot hoist over) — store params device-ordered and pass
+        # pre_interleaved=True to eliminate it (models/pipeline_lm.py
+        # ``device_ordered_pp`` does exactly that).
         stacked_params = interleave_stage_params(stacked_params, n_stages)
     mb = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
 
@@ -193,6 +193,22 @@ def pipeline_apply(
 def stack_stage_params(param_list):
     """Stack per-stage param pytrees along a new leading axis for P(pp)."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+def deinterleave_stage_params(stacked_params, n_stages: int):
+    """Inverse of :func:`interleave_stage_params`: device order back to
+    network order (for sequential-fallback execution or exporting a
+    device-ordered checkpoint portably)."""
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
+    (n_total,) = leading
+    n_virtual = n_total // n_stages
+    if n_virtual == 1:
+        return stacked_params
+    # network index k*S + d lives at device-order position d*v + k
+    perm = jnp.asarray(
+        [d * n_virtual + k for k in range(n_virtual) for d in range(n_stages)]
+    )
+    return jax.tree.map(lambda leaf: jnp.take(leaf, perm, axis=0), stacked_params)
 
 
 def interleave_stage_params(stacked_params, n_stages: int):

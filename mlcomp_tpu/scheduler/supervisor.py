@@ -147,11 +147,16 @@ class Supervisor:
         # (which clears the gang; the stop-watch in the surviving workers
         # then kills their children)
         for task in self.store.broken_gang_tasks():
-            if not self.store.requeue_task(task["id"]):
+            # expect_worker: if the gang actually finished (or was stopped /
+            # re-claimed) in the race window, neither transition may land
+            if not self.store.requeue_task(
+                task["id"], expect_worker=task["worker"]
+            ):
                 if self.store.finish_task(
                     task["id"],
                     TaskStatus.FAILED,
                     error="gang member died and retries exhausted",
+                    expect_worker=task["worker"],
                 ):
                     self._notify(
                         "task_failed",

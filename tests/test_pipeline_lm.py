@@ -118,6 +118,56 @@ def test_trainer_trains_pipelined_lm():
         set_current_mesh(None)
 
 
+def test_device_ordered_layout_matches_network_order():
+    """device_ordered_pp=4 stores stacks permutation-free: applying the
+    device-ordered model to interleave_stage_params(network params) must
+    equal the network-ordered model on the same mesh, and the sequential
+    fallback must un-permute correctly."""
+    from mlcomp_tpu.parallel.pipeline import interleave_stage_params
+
+    net = _model(layers=8, n_microbatches=4)
+    dev = _model(layers=8, n_microbatches=4, device_ordered_pp=4)
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 64, (8, 8)), jnp.int32)
+
+    seq_mesh = make_mesh(MeshSpec(dp=8))
+    set_current_mesh(seq_mesh)
+    variables = net.init(jax.random.PRNGKey(2), ids)
+    ref = jax.jit(net.apply)(variables, ids)
+
+    stages = {k: v for k, v in variables["params"].items()
+              if k.startswith("stages_")}
+    rest = {k: v for k, v in variables["params"].items()
+            if not k.startswith("stages_")}
+    dev_vars = {"params": {**rest, **interleave_stage_params(stages, 4)}}
+
+    # sequential fallback path (no pp axis) de-interleaves internally
+    out_seq = jax.jit(dev.apply)(dev_vars, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_seq), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
+
+    pp_mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    set_current_mesh(pp_mesh)
+    try:
+        out_pp = jax.jit(dev.apply)(
+            jax.device_put(dev_vars, replicated(pp_mesh)),
+            jax.device_put(ids, batch_sharding(pp_mesh)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_pp), np.asarray(ref), atol=2e-4, rtol=2e-4
+        )
+        # wrong-pp application must refuse, not mis-order layers
+        bad_mesh = make_mesh(MeshSpec(dp=4, pp=2))
+        set_current_mesh(bad_mesh)
+        with pytest.raises(ValueError, match="device-ordered"):
+            dev.apply(
+                jax.device_put(dev_vars, replicated(bad_mesh)),
+                jax.device_put(ids, batch_sharding(bad_mesh)),
+            )
+    finally:
+        set_current_mesh(None)
+
+
 def test_pipelined_rejects_indivisible_layers():
     model = _model(layers=6)
     ids = jnp.zeros((4, 8), jnp.int32)
