@@ -109,18 +109,24 @@ class SelfAttention(nn.Module):
             from mlcomp_tpu.parallel.ring import ring_attention_sharded
             from mlcomp_tpu.parallel.ulysses import ulysses_attention_sharded
 
+            from functools import partial
+
             mode = (
                 "ring" if self.seq_parallel is True else str(self.seq_parallel)
             )
             sp_attn = {
                 "ring": ring_attention_sharded,
+                # per-block compute through the Pallas flash kernel
+                # (parallel/ring.py _ring_flash) — opt-in, see ring.py
+                "ring_flash": partial(ring_attention_sharded, use_flash=True),
                 "ulysses": ulysses_attention_sharded,
             }
             # validate even when sp == 1, so a typo'd mode fails on the
             # first dev run rather than first pod launch
             if mode not in sp_attn:
                 raise ValueError(
-                    f"seq_parallel={mode!r}: expected 'ring' or 'ulysses'"
+                    f"seq_parallel={mode!r}: expected 'ring', 'ring_flash',"
+                    f" or 'ulysses'"
                 )
             mesh = current_mesh()
             if axis_size(mesh, "sp") > 1:

@@ -346,7 +346,8 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g,
+               g_lse=None):
     q, k, v, kv_lo, kv_hi, out, lse = res
     b, h, s_q, d = q.shape
     h_kv, s_k = k.shape[1], k.shape[2]
@@ -356,8 +357,14 @@ def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g):
     bounded = kv_lo is not None
 
     # delta_i = sum_d dO_i * O_i — tiny elementwise reduce; XLA fuses it.
+    # An lse cotangent folds in here exactly: dL/ds_ij has the out-path
+    # term p_ij (dp_ij - delta_i) plus the lse-path term g_lse_i p_ij
+    # (since dlse_i/ds_ij = p_ij), so shifting delta by -g_lse makes the
+    # unchanged kernels compute the combined gradient.
     # Broadcast over a 128-lane minor dim like lse (TPU block tiling).
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     def _call(kernel, grid, in_specs, out_specs, out_shape, scratch, operands):
@@ -462,6 +469,90 @@ def _flash_vjp_fwd(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, inte
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
+
+
+# ---- (out, lse) variant: building block for ring attention -----------------
+#
+# Ring attention merges per-KV-shard partial results with the online-
+# softmax rule, which needs each block's logsumexp alongside its
+# (normalized) output.  The lse is genuinely differentiable here (the
+# merge weights depend on it), handled by the delta shift in _flash_bwd.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_pair(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv,
+                interpret):
+    out, lse = _flash_fwd(
+        q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret
+    )
+    return out, lse[..., 0]
+
+
+def _flash_pair_vjp_fwd(q, k, v, kv_lo, kv_hi, scale, causal, block_q,
+                        block_kv, interpret):
+    out, lse = _flash_fwd(
+        q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret
+    )
+    return (out, lse[..., 0]), (q, k, v, kv_lo, kv_hi, out, lse)
+
+
+def _flash_pair_bwd(scale, causal, block_q, block_kv, interpret, res, gs):
+    g_out, g_lse = gs
+    return _flash_bwd(
+        scale, causal, block_q, block_kv, interpret, res, g_out, g_lse=g_lse
+    )
+
+
+_flash_pair.defvjp(_flash_pair_vjp_fwd, _flash_pair_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp (B, Sq, H) — the ring-attention building block.  Requires
+    lane-tileable shapes (no pad shim: ring shards are uniform) and no KV
+    windows.  NOTE: every row must have at least one live key (guaranteed
+    here: causal requires Sq == Sk, so row i always attends key i) — this
+    unbounded path has no masked-probability guard, so an empty-window
+    row would get the uniform-average failure the bounded kernel guards
+    against; ring "skip" blocks must use a sentinel instead of calling
+    the kernel.  Differentiable in (q, k, v) including the lse output's
+    cotangent path."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if s_q < LANES or s_k < LANES or s_q % LANES or s_k % LANES:
+        raise NotImplementedError(f"untileable ring shard: {s_q}/{s_k}")
+    if causal and s_q != s_k:
+        raise NotImplementedError("causal flash needs Sq == Sk")
+    block_q = block_q or _pick_block(s_q)
+    block_kv = block_kv or _pick_block(s_k, preferred=1024)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d_pad = (LANES - d % LANES) % LANES
+    if d_pad:
+        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    out, lse = _flash_pair(
+        qt, kt, vt, None, None, float(scale), bool(causal),
+        block_q, block_kv, bool(interpret),
+    )
+    if d_pad:
+        out = out[..., :d]
+    # (B, H, Sq, D) -> (B, Sq, H, D); lse (B, H, Sq) -> (B, Sq, H)
+    return jnp.swapaxes(out, 1, 2), jnp.swapaxes(lse, 1, 2)
 
 
 def flash_attention(

@@ -12,7 +12,13 @@ TPU build. The math is the same blocked online softmax as the Pallas flash
 kernel (ops/pallas/flash_attention.py), lifted one level up: blocks are
 device shards, the inner loop is a ``lax.scan`` over ring steps, and the
 rotation overlaps with the block compute under XLA's scheduler (the
-ppermute for step i+1 has no data dependency on step i's einsum).
+ppermute for step i+1 has no data dependency on step i's block compute).
+
+Opt-in (``use_flash=True`` / model ``seq_parallel: ring_flash``), the
+per-block compute runs the Pallas flash kernel (``flash_attention_lse``)
+and blocks merge by logsumexp — MXU-tiled inner attention with the lse
+cotangent handled exactly in the kernel backward.  The pure-jnp
+einsum-tile path is the reference implementation and the default.
 
 Differentiable by construction (pure jnp + ppermute, which is its own
 transpose), so the backward pass is another ring pass — no custom VJP.
@@ -120,6 +126,74 @@ def _block_attn(q, k, v, row0, col0, causal, scale):
     return acc, m, l
 
 
+def _merge_normalized(out, lse, o_b, l_b):
+    """Merge two NORMALIZED partial results via their logsumexps (the
+    flash-block form of the online merge; sentinel lse = NEG_INF/2 means
+    "no contribution" and stays finite so the exps never produce NaN)."""
+    l_new = jnp.logaddexp(lse, l_b)
+    a = jnp.exp(lse - l_new)[..., None]
+    b_ = jnp.exp(l_b - l_new)[..., None]
+    return out * a + o_b * b_, l_new
+
+
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    """Ring pass whose per-shard block compute is the Pallas flash kernel
+    (ops/pallas/flash_attention.py flash_attention_lse) instead of XLA
+    einsum tiles: each Q-shard x KV-shard block runs MXU-tiled with O(S)
+    memory, and blocks merge by logsumexp.  Causality is decided per
+    RING STEP (before = full block, diagonal = causal kernel, after =
+    skip), so the kernel never needs global offsets."""
+    from mlcomp_tpu.ops.pallas.flash_attention import flash_attention_lse
+
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, s_q, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def full_block(k_blk, v_blk):
+        o, l = flash_attention_lse(q, k_blk, v_blk, causal=False, scale=scale)
+        return o.astype(jnp.float32), l
+
+    def diag_block(k_blk, v_blk):
+        o, l = flash_attention_lse(q, k_blk, v_blk, causal=True, scale=scale)
+        return o.astype(jnp.float32), l
+
+    def skip_block(k_blk, v_blk):
+        return (
+            jnp.zeros((b, s_q, h, d), jnp.float32),
+            jnp.full((b, s_q, h), NEG_INF / 2, jnp.float32),
+        )
+
+    def step(carry, i):
+        k_blk, v_blk, out, lse = carry
+        src = (me - i) % n                      # whose shard we hold now
+        # rotate first: the collective has no dependency on this step's
+        # compute, so XLA can overlap ICI transfer with the kernel
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        if causal:
+            o_b, l_b = jax.lax.cond(
+                src == me,
+                diag_block,
+                lambda kb, vb: jax.lax.cond(
+                    src < me, full_block, skip_block, kb, vb
+                ),
+                k_blk, v_blk,
+            )
+        else:
+            o_b, l_b = full_block(k_blk, v_blk)
+        out, lse = _merge_normalized(out, lse, o_b, l_b)
+        return (k_nxt, v_nxt, out, lse), None
+
+    zero = q.reshape(-1)[0].astype(jnp.float32) * 0.0  # imprint varying type
+    out0 = jnp.zeros((b, s_q, h, d), jnp.float32) + zero
+    lse0 = jnp.full((b, s_q, h), NEG_INF / 2, jnp.float32) + zero
+    (_, _, out, _), _ = jax.lax.scan(
+        step, (k, v, out0, lse0), jnp.arange(n), length=n
+    )
+    return out.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -127,12 +201,18 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Attention over a sequence sharded on ``axis_name``.
 
     Call INSIDE shard_map/jit-with-sharding: q, k, v are the per-device
     shards (B, S_local, H|Hkv, D), sequence-contiguous in ring order.
     Returns the local output shard (B, S_local, H, D).
+
+    ``use_flash``: run each Q-shard × KV-shard block through the Pallas
+    flash kernel.  None currently means False (opt-in — see the inline
+    comment for the measurement caveat); the einsum-tile path is the
+    reference implementation and the default.
     """
     n = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
@@ -142,6 +222,27 @@ def ring_attention(
     row0 = me * s_q
     h_kv = k.shape[2]
     rep = h // h_kv
+
+    tileable = (
+        s_q >= 128 and s_k >= 128 and s_q % 128 == 0 and s_k % 128 == 0
+        and s_q == s_k
+    )
+    if use_flash is None:
+        # OPT-IN for now: the flash-block path is numerically verified
+        # (fwd + bwd vs the einsum path, tests/test_ring_attention.py),
+        # and its forward measured faster on the v5e chip — but backward
+        # timings through scan+shard_map on the tunneled compile service
+        # varied 30x BETWEEN SESSIONS for byte-identical programs, so an
+        # auto-on default cannot be justified from this environment.
+        # Flip after profiling on directly-attached multi-chip hardware.
+        use_flash = False
+    if use_flash:
+        if not tileable:
+            raise NotImplementedError(
+                f"ring flash path needs equal lane-tileable shards; got "
+                f"{s_q}/{s_k}"
+            )
+        return _ring_flash(q, k, v, axis_name, causal, scale)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -174,6 +275,7 @@ def ring_attention_sharded(
     causal: bool = False,
     scale: Optional[float] = None,
     axis_name: str = "sp",
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """shard_map wrapper: global (B, S, H, D) arrays, S sharded over sp.
 
@@ -187,9 +289,14 @@ def ring_attention_sharded(
     h_kv = k.shape[2]
     spec = seq_shard_spec(mesh, b, h, h_kv, axis_name)
     fn = jax.shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal, scale=scale),
+        partial(ring_attention, axis_name=axis_name, causal=causal,
+                scale=scale, use_flash=use_flash),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call out_shapes carry no varying-mesh-axes metadata, so
+        # the vma type check cannot see through the flash-kernel path;
+        # the einsum path keeps the check (the specs pin the contract)
+        check_vma=not use_flash,
     )
     return fn(q, k, v)
