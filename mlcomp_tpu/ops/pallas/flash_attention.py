@@ -453,6 +453,19 @@ def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, g,
 # --------------------------------------------------------------------------
 
 
+def _kernel_layout(q, k, v, d):
+    """(B, S, H, D) → (B, H, S, D) with head_dim zero-padded to a lane
+    multiple — the shared entry transform for both public wrappers."""
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d_pad = (LANES - d % LANES) % LANES
+    if d_pad:
+        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    return (qt, kt, vt), d_pad
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def _flash(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret):
     out, _ = _flash_fwd(
@@ -527,7 +540,9 @@ def flash_attention_lse(
     the kernel.  Differentiable in (q, k, v) including the lse output's
     cotangent path."""
     b, s_q, h, d = q.shape
-    s_k = k.shape[1]
+    s_k, h_kv = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     if s_q < LANES or s_k < LANES or s_q % LANES or s_k % LANES:
         raise NotImplementedError(f"untileable ring shard: {s_q}/{s_k}")
     if causal and s_q != s_k:
@@ -538,13 +553,7 @@ def flash_attention_lse(
         interpret = jax.default_backend() not in ("tpu", "axon")
     scale = scale if scale is not None else 1.0 / (d**0.5)
 
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    d_pad = (LANES - d % LANES) % LANES
-    if d_pad:
-        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
-        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    (qt, kt, vt), d_pad = _kernel_layout(q, k, v, d)
     out, lse = _flash_pair(
         qt, kt, vt, None, None, float(scale), bool(causal),
         block_q, block_kv, bool(interpret),
@@ -629,14 +638,7 @@ def flash_attention(
     if s_qp % block_q or s_kp % block_kv:
         raise NotImplementedError("sequence lengths must tile into blocks")
 
-    # (B, S, H, D) -> (B, H, S, D); pad head_dim to a lane multiple
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    d_pad = (LANES - d % LANES) % LANES
-    if d_pad:
-        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
-        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    (qt, kt, vt), d_pad = _kernel_layout(q, k, v, d)
 
     out = _flash(qt, kt, vt, kv_lo, kv_hi, float(scale), bool(causal),
                  block_q, block_kv, bool(interpret))

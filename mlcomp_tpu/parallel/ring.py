@@ -223,9 +223,11 @@ def ring_attention(
     h_kv = k.shape[2]
     rep = h // h_kv
 
+    from mlcomp_tpu.ops.pallas.flash_attention import LANES
+
     tileable = (
-        s_q >= 128 and s_k >= 128 and s_q % 128 == 0 and s_k % 128 == 0
-        and s_q == s_k
+        s_q >= LANES and s_k >= LANES and s_q % LANES == 0
+        and s_k % LANES == 0 and s_q == s_k
     )
     if use_flash is None:
         # OPT-IN for now: the flash-block path is numerically verified

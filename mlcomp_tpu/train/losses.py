@@ -110,6 +110,22 @@ def lm_cross_entropy(logits, batch):
     return masked_mean(per, batch)
 
 
+@LOSSES.register("lm_cross_entropy_fused")
+def lm_cross_entropy_fused(outputs, batch):
+    """Pairs with ``model.fused_loss: true``: the model already computed
+    per-token CE losses (B, S) via the chunked fused head
+    (ops/fused_ce.py) — the (B, S, V) logits never existed.  The final
+    position carries a dummy label and is dropped here."""
+    if outputs.ndim != 2:
+        raise ValueError(
+            "lm_cross_entropy_fused expects per-token losses (B, S) — "
+            "set fused_loss: true on the model (and note decode/logits "
+            "consumers can't run against fused outputs)"
+        )
+    per = outputs[:, :-1].mean(axis=-1)
+    return masked_mean(per, batch)
+
+
 @LOSSES.register("dice")
 def dice_loss(logits, batch, eps: float = 1e-6):
     """Soft dice over one-hot classes; segmentation complement to pixel CE.
