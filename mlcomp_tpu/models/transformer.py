@@ -213,6 +213,12 @@ class _LMHead(nn.Module):
 
     vocab_size: int
     hidden: int
+    # Dense-equivalent semantics (y = x @ kernel, no bias): advertise to
+    # ops/quant.py's method interception so int8 decoding routes this
+    # module through the Pallas kernel like the Dense it replaced;
+    # dtype keeps the intercepted output fp32 like the plain path
+    quant_kernel_eligible = True
+    dtype = jnp.float32
 
     def setup(self):
         self.kernel = self.param(

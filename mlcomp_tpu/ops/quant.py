@@ -114,10 +114,13 @@ def dequantize_nonkernel_params(params, dtype=jnp.bfloat16):
       → dequantized here, so ``model.apply`` never meets a {"q8", ...}
       dict it doesn't understand.
 
-    A custom non-Dense module whose 2-D param happens to be NAMED
-    ``kernel`` is the one unsupported corner (it would stay int8 but not
-    be intercepted) — name params differently or skip ``quant_kernel``
-    for such models."""
+    A custom module with Dense semantics can opt into interception by
+    setting ``quant_kernel_eligible = True`` as a class attribute (the LM
+    head does; ``dtype``/``use_bias`` attrs are honored when present).
+    The remaining unsupported corner is a NON-eligible custom module
+    whose 2-D param happens to be named ``kernel`` — it would stay int8
+    but not be intercepted; name such params differently or skip
+    ``quant_kernel``."""
     from jax.tree_util import tree_map_with_path
 
     def visit(path, leaf):
@@ -155,6 +158,11 @@ def quant_kernel_interception():
     def dense_like(mod):
         if type(mod) is nn.Dense:
             return True
+        # opt-in protocol for framework modules with Dense semantics
+        # (y = x @ kernel [+ bias]) that aren't flax Dense — e.g. the
+        # LM head module that exposes its kernel for the fused loss
+        if getattr(type(mod), "quant_kernel_eligible", False):
+            return True
         if type(mod) is nn.DenseGeneral:
             # a single trailing contraction axis and no batch dims is
             # exactly Dense semantics (2-D kernel, features last)
@@ -174,7 +182,7 @@ def quant_kernel_interception():
             k = mod.get_variable("params", "kernel")
             if is_quantized_leaf(k) and k[_QKEY].ndim == 2:
                 x = args[0]
-                out_dtype = mod.dtype or x.dtype
+                out_dtype = getattr(mod, "dtype", None) or x.dtype
                 if kernel_consumable(k):
                     xs = x.shape
                     x2 = x.reshape(-1, xs[-1]).astype(jnp.bfloat16)
@@ -186,7 +194,7 @@ def quant_kernel_interception():
                         x.astype(out_dtype)
                         @ dequantize_leaf(k, out_dtype)
                     )
-                if mod.use_bias:
+                if getattr(mod, "use_bias", False):
                     bias = mod.get_variable("params", "bias")
                     out = out + bias.astype(out_dtype)
                 return out
