@@ -46,6 +46,14 @@ def run_dag_local(
             Path(tempfile.mkdtemp(prefix="mlcomp_tpu_")) / "mlcomp.sqlite"
         )
 
+    # multi-host tasks gang-schedule: they need one worker PER slot and
+    # isolated child processes (each slot runs its own jax.distributed
+    # process) — on a dev box "multi-host" degrades gracefully to
+    # multi-process on localhost
+    max_hosts = max((t.resources.hosts for t in dag.tasks), default=1)
+    isolate = max_hosts > 1
+    workers = max(1, workers, max_hosts)
+
     store = Store(db_path)
     dag_id = store.submit_dag(dag)
     sup = Supervisor(store, worker_timeout_s=worker_timeout_s)
@@ -54,7 +62,8 @@ def run_dag_local(
 
     def worker_loop(idx: int):
         wstore = Store(db_path)
-        w = Worker(wstore, name=f"local-{idx}", chips=chips, workdir=workdir)
+        w = Worker(wstore, name=f"local-{idx}", chips=chips, workdir=workdir,
+                   isolate=isolate)
         while not stop.is_set():
             if not w.run_once():
                 time.sleep(0.02)
@@ -62,7 +71,7 @@ def run_dag_local(
 
     threads = [
         threading.Thread(target=worker_loop, args=(i,), daemon=True)
-        for i in range(max(1, workers))
+        for i in range(workers)
     ]
     for t in threads:
         t.start()

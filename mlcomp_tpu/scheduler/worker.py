@@ -136,6 +136,7 @@ class Worker:
         self.child_env = dict(child_env or {})
         self._free_chip_ids = set(range(chips))
         self._children: List[Dict[str, Any]] = []
+        self._sweep_stale_scratch()
         if load_jax_executors:
             from mlcomp_tpu import executors
 
@@ -143,6 +144,38 @@ class Worker:
 
     def _sync_code(self, args: Dict[str, Any], task_id: int) -> None:
         sync_code(args, task_id, self.workdir, self.store)
+
+    def _sweep_stale_scratch(self) -> None:
+        """Remove ``.task-*`` child scratch dirs orphaned by a worker
+        process that died mid-task (normal exits clean up inline).
+
+        A dir is only swept when its recorded owner pid is gone —
+        concurrent workers sharing a workdir must not delete each other's
+        live scratch (a pid-less dir is a half-created orphan and also
+        goes).  Skipped entirely under MLCOMP_TPU_KEEP_CHILD_SCRATCH so
+        kept debug evidence survives restarts."""
+        if os.environ.get("MLCOMP_TPU_KEEP_CHILD_SCRATCH"):
+            return
+        import glob
+        import shutil
+
+        for d in glob.glob(os.path.join(self.workdir, ".task-*")):
+            try:
+                pid = int(
+                    open(os.path.join(d, "owner.pid")).read().strip()
+                )
+                os.kill(pid, 0)  # raises if the owner is gone
+                continue  # live owner: leave it alone
+            except (OSError, ValueError):
+                pass
+            try:
+                # pid-less dirs younger than a minute may be mid-creation
+                # by a concurrent worker (mkdtemp -> pid-file window)
+                if time.time() - os.path.getmtime(d) < 60.0:
+                    continue
+            except OSError:
+                pass
+            shutil.rmtree(d, ignore_errors=True)
 
     # ------------------------------------------------------------ heartbeats
 
@@ -181,6 +214,9 @@ class Worker:
         spec_path = os.path.join(scratch, "spec.json")
         result_path = os.path.join(scratch, "result.json")
         log_path = os.path.join(scratch, "child.log")
+        # ownership marker for the startup sweep (see _sweep_stale_scratch)
+        with open(os.path.join(scratch, "owner.pid"), "w") as f:
+            f.write(str(os.getpid()))
         spec = {
             "db": self.store.path,
             "claim": claim,

@@ -223,6 +223,45 @@ def check(ctx):
     assert "gang slot 0/2" in logs and "gang slot 1/2" in logs
 
 
+def test_local_runner_gangs_multihost_dag(tmp_path):
+    """`cli dag` path: run_dag_local detects hosts>1, raises the worker
+    count, switches to isolated children, and the gang completes."""
+    from mlcomp_tpu.scheduler.local import run_dag_local
+
+    helper = tmp_path / "src" / "lr_helper.py"
+    helper.parent.mkdir()
+    helper.write_text(
+        "import jax\n"
+        "def check(ctx):\n"
+        "    return {'processes': jax.process_count()}\n"
+    )
+    dag = {
+        "info": {"name": "lr-mh", "project": "t"},
+        "executors": {
+            "mh": {
+                "type": "pyfunc",
+                "resources": {"hosts": 2},
+                "args": {"target": "lr_helper:check",
+                         "code_src": str(helper.parent)},
+            },
+        },
+    }
+    db = str(tmp_path / "db.sqlite")
+    statuses = run_dag_local(
+        dag, db_path=db, workdir=str(tmp_path), timeout_s=240.0,
+    )
+    assert all(s == TaskStatus.SUCCESS for s in statuses.values()), statuses
+    store = Store(db)
+    try:
+        row = store.task_rows(1)[0]
+        # the gang really ran: two jax.distributed processes
+        import json
+
+        assert json.loads(row["result"]) == {"processes": 2}
+    finally:
+        store.close()
+
+
 def test_gang_train_executor_two_processes(store, tmp_path):
     """The REAL train executor under hosts=2: the Trainer builds its mesh
     over the 16-device global view, the loader feeds via
