@@ -156,13 +156,13 @@ def generate(
     #   cost HALF the HBM read per token.  3-D attention projections
     #   still dequantize at entry (their per-channel scales don't factor
     #   out of the contraction).
-    # Measured (v5e, 268M LM, B=4, 128 new tokens, interleaved medians):
-    # bf16 pre-cast 1.74 ms/tok, int8 entry-dequant 1.63, int8 kernel
-    # 1.61 — the kernel wins, but modestly: at this size the step is only
-    # ~40% weight reads (attention over the cache, the fp32 logits head,
-    # and per-op overheads make up the rest).  At B=1 the Pallas per-call
-    # overhead outweighs the read saving (bf16 wins); the kernel path is
-    # the right default for batched serving, not single-stream.
+    # Measured (v5e, 268M LM, 128 new tokens, interleaved medians,
+    # ms/tok): B=4 bf16 1.74 / entry 1.63 / kernel 1.61; B=8 bf16 1.68 /
+    # entry 1.60 / kernel 1.72.  The kernel wins only in the weight-
+    # bound middle (B≈4): at B=1 Pallas per-call overhead dominates
+    # (bf16 wins) and at B≥8 weights amortize over rows so entry-dequant
+    # bf16 edges ahead.  Deltas are within ~5% of session noise — treat
+    # the mode as a knob to A/B on the target batch, not a universal win.
     use_quant_kernel = False
     if has_quantized(variables):
         from mlcomp_tpu.ops.quant import dequantize_nonkernel_params
