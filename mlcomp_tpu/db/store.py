@@ -85,7 +85,8 @@ CREATE TABLE IF NOT EXISTS workers (
     chips     INTEGER NOT NULL DEFAULT 0,
     busy_chips INTEGER NOT NULL DEFAULT 0,
     heartbeat REAL NOT NULL,
-    status    TEXT NOT NULL DEFAULT 'alive'
+    status    TEXT NOT NULL DEFAULT 'alive',
+    info      TEXT
 );
 CREATE TABLE IF NOT EXISTS gang (
     task_id     INTEGER NOT NULL,
@@ -122,6 +123,20 @@ class Store:
         and re-check the schema after acquiring it, and a crash mid-rebuild
         rolls back.  A stranded ``metrics_legacy`` (from a pre-atomic build
         dying mid-copy) is folded back in first."""
+
+        # additive columns land with a plain ALTER (no rebuild needed);
+        # concurrent opens of a legacy file can both see the column
+        # missing, so the loser's duplicate ALTER is expected and benign
+        worker_cols = {
+            r["name"]
+            for r in self._conn.execute("PRAGMA table_info(workers)")
+        }
+        if worker_cols and "info" not in worker_cols:
+            try:
+                self._conn.execute("ALTER TABLE workers ADD COLUMN info TEXT")
+            except sqlite3.OperationalError as e:
+                if "duplicate column" not in str(e):
+                    raise
 
         def value_notnull() -> bool:
             cols = {
@@ -849,15 +864,29 @@ class Store:
 
     # --------------------------------------------------------------- workers
 
-    def heartbeat(self, worker: str, chips: int, busy_chips: int = 0) -> None:
+    def heartbeat(
+        self,
+        worker: str,
+        chips: int,
+        busy_chips: int = 0,
+        info: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Record liveness; ``info`` carries host metrics (loadavg, free
+        RAM, running task ids — the TPU-VM analog of the reference's
+        per-worker GPU utilization panel).  ``info=None`` keeps the last
+        reported value so cheap liveness-only beats don't blank it."""
         with self._tx() as c:
             c.execute(
-                "INSERT INTO workers (name, chips, busy_chips, heartbeat, status)"
-                " VALUES (?,?,?,?,'alive')"
+                "INSERT INTO workers (name, chips, busy_chips, heartbeat,"
+                " status, info) VALUES (?,?,?,?,'alive',?)"
                 " ON CONFLICT(name) DO UPDATE SET chips=excluded.chips,"
                 " busy_chips=excluded.busy_chips, heartbeat=excluded.heartbeat,"
-                " status='alive'",
-                (worker, chips, busy_chips, time.time()),
+                " status='alive',"
+                " info=COALESCE(excluded.info, workers.info)",
+                (
+                    worker, chips, busy_chips, time.time(),
+                    json.dumps(info) if info is not None else None,
+                ),
             )
 
     def workers(self) -> List[Dict[str, Any]]:

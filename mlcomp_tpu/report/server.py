@@ -336,10 +336,13 @@ async function refresh(){
    row(tt,[link(x.id,()=>showTask(x.id)),x.name,x.executor,x.stage,
     [x.status,x.status],x.worker||'',x.error||'',tact(x)]);}
  const ws=await J('/api/workers');const wt=document.getElementById('workers');
- wt.innerHTML='';row(wt,['name','chips','busy','status','heartbeat'],true);
- for(const w of ws)row(wt,[w.name,w.chips,w.busy_chips,
-  [w.status,w.status==='alive'?'success':'failed'],
-  new Date(w.heartbeat*1000).toLocaleTimeString()]);
+ wt.innerHTML='';row(wt,['name','chips','busy','status','load','free RAM','tasks','heartbeat'],true);
+ for(const w of ws){let i={};try{i=JSON.parse(w.info||'{}')}catch(e){}
+  row(wt,[w.name,w.chips,w.busy_chips,
+   [w.status,w.status==='alive'?'success':'failed'],
+   i.load1??'',i.mem_free_gb!==undefined?i.mem_free_gb+' GB':'',
+   (i.tasks||[]).join(', '),
+   new Date(w.heartbeat*1000).toLocaleTimeString()]);}
  const ms=await J('/api/models');const mt=document.getElementById('models');
  mt.innerHTML='';
  if(ms.length){row(mt,['project','dag','task','checkpoints','artifacts','updated'],true);

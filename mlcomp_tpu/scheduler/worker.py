@@ -179,12 +179,47 @@ class Worker:
 
     # ------------------------------------------------------------ heartbeats
 
-    def _heartbeat_pump(self, busy_chips: int, stop: threading.Event) -> None:
+    def _host_info(self, extra_tasks: tuple = ()) -> Dict[str, Any]:
+        """Host metrics riding the heartbeat — the TPU-VM analog of the
+        reference's per-worker GPU utilization panel.  The worker daemon
+        itself never initializes JAX (its children own the chips), so
+        this reports host-side signals: load, free RAM, running tasks.
+        ``extra_tasks``: ids running outside the poll() children pool
+        (the blocking run_once path)."""
+        info: Dict[str, Any] = {
+            "tasks": sorted(
+                {int(c["claim"]["id"]) for c in self._children}
+                | set(extra_tasks)
+            ),
+            "pid": os.getpid(),
+        }
+        try:
+            info["load1"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        try:
+            with open("/proc/meminfo") as f:
+                mem = dict(
+                    line.split(":", 1) for line in f.read().splitlines() if ":" in line
+                )
+            info["mem_free_gb"] = round(
+                int(mem["MemAvailable"].strip().split()[0]) / 1e6, 2
+            )
+        except (OSError, KeyError, ValueError):
+            pass
+        return info
+
+    def _heartbeat_pump(
+        self, busy_chips: int, stop: threading.Event, task_id: int
+    ) -> None:
         """Own-connection heartbeat loop (sqlite connections are per-thread)."""
         hb_store = Store(self.store.path)
         try:
             while not stop.wait(self.heartbeat_interval_s):
-                hb_store.heartbeat(self.name, self.chips, busy_chips=busy_chips)
+                hb_store.heartbeat(
+                    self.name, self.chips, busy_chips=busy_chips,
+                    info=self._host_info(extra_tasks=(task_id,)),
+                )
         finally:
             hb_store.close()
 
@@ -492,7 +527,9 @@ class Worker:
         self.store.heartbeat(self.name, self.chips, busy_chips=claim["chips"])
         stop = threading.Event()
         pump = threading.Thread(
-            target=self._heartbeat_pump, args=(claim["chips"], stop), daemon=True
+            target=self._heartbeat_pump,
+            args=(claim["chips"], stop, claim["id"]),
+            daemon=True,
         )
         pump.start()
         try:
@@ -563,7 +600,9 @@ class Worker:
                 progressed = True
                 if self._try_spawn(gathered["claim"], gathered["gang"]):
                     busy = int(gathered["claim"]["chips"])
-        self.store.heartbeat(self.name, self.chips, busy_chips=busy)
+        self.store.heartbeat(
+            self.name, self.chips, busy_chips=busy, info=self._host_info()
+        )
         return progressed
 
     def run_forever(self, poll_interval: float = 0.5) -> None:

@@ -130,3 +130,38 @@ def test_add_report_sanitizes_nonfinite(tmp_db):
     assert payload["worst"][0]["confidence"] is None
     assert payload["ok"] == 1.5
     store.close()
+
+
+def test_heartbeat_info_roundtrip_and_migration(tmp_path):
+    """Host metrics ride the heartbeat; info=None keeps the last value;
+    pre-info schema files gain the column via migration."""
+    import json
+    import sqlite3
+
+    from mlcomp_tpu.db.store import Store
+
+    # legacy file without the info column
+    legacy = str(tmp_path / "legacy.sqlite")
+    conn = sqlite3.connect(legacy)
+    conn.execute(
+        "CREATE TABLE workers (name TEXT PRIMARY KEY, chips INTEGER NOT"
+        " NULL DEFAULT 0, busy_chips INTEGER NOT NULL DEFAULT 0,"
+        " heartbeat REAL NOT NULL, status TEXT NOT NULL DEFAULT 'alive')"
+    )
+    conn.execute(
+        "INSERT INTO workers VALUES ('old', 2, 0, 1.0, 'alive')"
+    )
+    conn.commit()
+    conn.close()
+
+    s = Store(legacy)
+    s.heartbeat("w", chips=4, info={"load1": 0.5, "tasks": [7]})
+    s.heartbeat("w", chips=4)  # liveness-only beat must not blank info
+    rows = {r["name"]: r for r in s.workers()}
+    assert json.loads(rows["w"]["info"]) == {"load1": 0.5, "tasks": [7]}
+    assert rows["old"]["info"] is None
+    s.heartbeat("w", chips=4, info={"load1": 1.5})
+    assert json.loads(
+        {r["name"]: r for r in s.workers()}["w"]["info"]
+    )["load1"] == 1.5
+    s.close()
