@@ -839,7 +839,7 @@ def flash_attention_lse(
         raise NotImplementedError(f"untileable ring shard: {s_q}/{s_k}")
     if causal and s_q != s_k:
         raise NotImplementedError("causal flash needs Sq == Sk")
-    block_q = block_q or _pick_block(s_q)
+    block_q = block_q or _pick_block(s_q, preferred=1024 if causal else 512)
     block_kv = block_kv or _pick_block(s_k, preferred=1024)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
@@ -924,11 +924,15 @@ def flash_attention(
         k = jnp.pad(k, ((0, 0), (0, pad_sk), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_sk), (0, 0), (0, 0)))
     s_qp, s_kp = s_q + pad_sq, s_k + pad_sk
-    # measured on v5e at S=4096 (B4 H8 D128): KV block 1024 beats 512 by
-    # ~25% fwd (fewer grid steps amortize per-step overhead better than
-    # small blocks exploit the causal/window skip); q block 512 wins over
-    # 1024 under causal (finer dead-row granularity)
-    block_q = block_q or _pick_block(s_qp)
+    # measured on v5e at S=4096 (B4 H8 D128): fewer grid steps amortize
+    # per-step overhead better than small blocks exploit skip granularity
+    # — KV block 1024 beats 512 by ~25% fwd; under the causal TRIANGULAR
+    # grids 1024/1024 is best overall (fwd+bwd 16.7 ms vs 18.4 at
+    # 512/1024), while the rectangular (bounded/non-causal) backward
+    # prefers q block 512
+    block_q = block_q or _pick_block(
+        s_qp, preferred=1024 if causal else 512
+    )
     block_kv = block_kv or _pick_block(s_kp, preferred=1024)
     if s_qp % block_q or s_kp % block_kv:
         raise NotImplementedError("sequence lengths must tile into blocks")
