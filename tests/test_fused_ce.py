@@ -98,3 +98,27 @@ def test_fused_loss_rejects_logits():
 
     with pytest.raises(ValueError, match="per-token"):
         create_loss("lm_cross_entropy_fused")(jnp.zeros((2, 8, 32)), {})
+
+
+def test_lm_feature_matrix_composes():
+    """The LM memory/parallelism knobs compose: fused_loss + remat +
+    sequence parallelism + grad_accum + adafactor in one jitted step."""
+    import numpy as np
+
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+                  "layers": 2, "heads": 4, "dtype": "float32",
+                  "fused_loss": True, "fused_loss_chunk": 32, "remat": True,
+                  "seq_parallel": "ring"},
+        "optimizer": {"name": "adafactor", "lr": 1e-3},
+        "loss": "lm_cross_entropy_fused", "metrics": [], "epochs": 1,
+        "seed": 0, "grad_accum": 2,
+        "mesh": {"dp": 2, "sp": 4},
+        "data": {"train": {"name": "synthetic_tokens", "n": 8,
+                           "seq_len": 64, "vocab_size": 64,
+                           "batch_size": 4}},
+    }
+    stats = Trainer(cfg).train_epoch()
+    assert np.isfinite(stats["loss"])
