@@ -56,18 +56,36 @@ class MoEBlock(nn.Module):
         )
         probs = jax.nn.softmax(logits, axis=-1)                      # (T, E)
 
+        from mlcomp_tpu.ops.quant import is_quantized_leaf
+
         w1 = self.param(
             "experts_w1",
             nn.initializers.normal(0.02),
             (e, d, self.d_ff),
             jnp.float32,
-        ).astype(self.dtype)
+        )
         w2 = self.param(
             "experts_w2",
             nn.initializers.normal(0.02),
             (e, self.d_ff, d),
             jnp.float32,
-        ).astype(self.dtype)
+        )
+        # int8 decode: stacked expert weights may arrive quantized
+        # ({"q8": (E, in, out) int8, "q8_scale": (E, 1, out)} — per-expert
+        # per-channel scales, so each expert's 2-D slice feeds the Pallas
+        # kernel directly in the inference scan).  Measured on v5e (638M
+        # moe_lm, B=4, interleaved medians): throughput NEUTRAL vs bf16
+        # (3.48 vs 3.43 ms/tok — per-call kernel overhead in the E-step
+        # scan offsets the halved read), but weight HBM RESIDENCY halves
+        # (entry dequant would materialize the bf16 copy), so the int8
+        # path is the serving-density option: ~2x more MoE weights per
+        # chip.
+        quantized = is_quantized_leaf(w1)
+        if quantized and train:
+            raise ValueError("int8 expert weights are decode-only")
+        if not quantized:
+            w1 = w1.astype(self.dtype)
+            w2 = w2.astype(self.dtype)
 
         if not train:
             # Inference is DROP-FREE: capacity competition exists for
@@ -90,10 +108,19 @@ class MoEBlock(nn.Module):
             # scan one expert at a time: peak intermediate is (T, d_ff),
             # not (T, E, d_ff) — dense routing must not spike eval memory
             # E× past what a training step uses
+            if quantized:
+                from mlcomp_tpu.ops.quant import expert_matmul
+
+                mm = lambda a, w: expert_matmul(a, w, self.dtype)  # noqa: E731
+            else:
+                mm = lambda a, w: a @ w                            # noqa: E731
+
             def one_expert(acc, wse):
                 w1_e, w2_e, we = wse
-                h_e = jax.nn.gelu(toks @ w1_e)                       # (T, F)
-                return acc + we[:, None].astype(self.dtype) * (h_e @ w2_e), None
+                h_e = jax.nn.gelu(mm(toks, w1_e))                  # (T, F)
+                return acc + we[:, None].astype(self.dtype) * (
+                    mm(h_e, w2_e)
+                ), None
 
             out, _ = jax.lax.scan(
                 one_expert,

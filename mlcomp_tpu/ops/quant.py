@@ -131,9 +131,35 @@ def dequantize_nonkernel_params(params, dtype=jnp.bfloat16):
             return leaf
         if key == "kernel" and kernel_consumable(leaf):
             return leaf
+        if (
+            key in ("experts_w1", "experts_w2")
+            and leaf[_QKEY].ndim == 3
+            and leaf[_QKEY].shape[-2] % 128 == 0
+            and leaf[_QKEY].shape[-1] % 128 == 0
+        ):
+            # stacked MoE expert weights: the inference scan slices the
+            # expert axis and feeds 2-D slices to expert_matmul
+            # (models/moe.py) — per-expert scales factor out per slice.
+            # Non-tileable shapes dequantize at entry instead: in-scan
+            # inline dequant re-reads the int8 every step (measured
+            # slower than bf16, module docstring).
+            return leaf
         return dequantize_leaf(leaf, dtype)
 
     return tree_map_with_path(visit, params, is_leaf=is_quantized_leaf)
+
+
+def expert_matmul(x, leaf: Dict[str, jax.Array], dtype) -> jax.Array:
+    """``x @ dequant(leaf)`` for a 2-D quantized slice (a scan-sliced MoE
+    expert weight).  Tileable slices run the Pallas int8 kernel (dequant
+    fused in VMEM); others dequantize inline — both exact."""
+    if kernel_consumable(leaf):
+        from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+
+        return quant_matmul(
+            x.astype(jnp.bfloat16), leaf[_QKEY], leaf[_SKEY].reshape(-1)
+        ).astype(dtype)
+    return x.astype(dtype) @ dequantize_leaf(leaf, dtype)
 
 
 def quant_kernel_interception():

@@ -154,3 +154,48 @@ def test_generate_quant_kernel_runs():
     assert a.shape == b.shape == (2, 7)
     # same int8 source: the very first sampled token must agree
     np.testing.assert_array_equal(np.asarray(a[:, 4]), np.asarray(b[:, 4]))
+
+
+def test_moe_quantized_decode_matches_entry_dequant():
+    """MoE generation with int8 expert weights consumed in the scan (the
+    Pallas slice path) matches full-precision decoding closely and runs
+    end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.generation import generate
+    from mlcomp_tpu.ops.quant import quantize_params
+    from mlcomp_tpu.train.state import init_model
+
+    model = create_model({
+        "name": "moe_lm", "vocab_size": 64, "hidden": 128, "layers": 2,
+        "heads": 2, "n_experts": 2, "d_ff": 256, "moe_every": 1,
+        "dtype": "float32",
+    })
+    prompt = jnp.asarray(np.random.RandomState(9).randint(1, 64, (2, 4)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    q = {"params": quantize_params(params, min_size=1024)}
+    a = generate(model, q, prompt, 3)                      # entry dequant
+    b = generate(model, q, prompt, 3, quant_kernel=True)   # scan int8 path
+    assert a.shape == b.shape == (2, 7)
+    np.testing.assert_array_equal(np.asarray(a[:, 4]), np.asarray(b[:, 4]))
+
+
+def test_moe_train_rejects_quantized_experts():
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from mlcomp_tpu.models.moe import MoEBlock
+    from mlcomp_tpu.ops.quant import quantize_leaf
+
+    block = MoEBlock(n_experts=2, d_model=128, d_ff=256, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).normal(size=(2, 4, 128)),
+                    jnp.float32)
+    params = block.init(jax.random.PRNGKey(0), x)["params"]
+    qp = dict(params)
+    qp["experts_w1"] = quantize_leaf(params["experts_w1"])
+    qp["experts_w2"] = quantize_leaf(params["experts_w2"])
+    with _pytest.raises(ValueError, match="decode-only"):
+        block.apply({"params": qp}, x, train=True)
