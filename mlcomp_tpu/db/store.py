@@ -46,6 +46,7 @@ CREATE TABLE IF NOT EXISTS tasks (
     priority    INTEGER NOT NULL DEFAULT 0,
     max_retries INTEGER NOT NULL DEFAULT 0,
     retries     INTEGER NOT NULL DEFAULT 0,
+    infra_requeues INTEGER NOT NULL DEFAULT 0,
     status      TEXT NOT NULL DEFAULT 'not_ran',
     worker      TEXT,
     started     REAL,
@@ -134,6 +135,19 @@ class Store:
         if worker_cols and "info" not in worker_cols:
             try:
                 self._conn.execute("ALTER TABLE workers ADD COLUMN info TEXT")
+            except sqlite3.OperationalError as e:
+                if "duplicate column" not in str(e):
+                    raise
+        task_cols = {
+            r["name"]
+            for r in self._conn.execute("PRAGMA table_info(tasks)")
+        }
+        if task_cols and "infra_requeues" not in task_cols:
+            try:
+                self._conn.execute(
+                    "ALTER TABLE tasks ADD COLUMN infra_requeues"
+                    " INTEGER NOT NULL DEFAULT 0"
+                )
             except sqlite3.OperationalError as e:
                 if "duplicate column" not in str(e):
                     raise
@@ -568,18 +582,39 @@ class Store:
                 c.execute("DELETE FROM gang WHERE task_id=?", (task_id,))
             return cur.rowcount == 1
 
-    def requeue_task(self, task_id: int, expect_worker: Optional[str] = None) -> bool:
+    def requeue_task(
+        self,
+        task_id: int,
+        expect_worker: Optional[str] = None,
+        consume_retry: bool = True,
+    ) -> bool:
         """Put a task back in the queue, consuming one retry. False if spent.
 
         Only fires while the task is still IN_PROGRESS (a stopped or
         already-requeued task must not be resurrected by a stale worker);
         with ``expect_worker`` it additionally requires the task to still
-        be assigned to that worker — the same guard ``finish_task`` has."""
-        q = (
-            "UPDATE tasks SET status=?, worker=NULL, started=NULL,"
-            " retries=retries+1 WHERE id=? AND retries < max_retries"
-            " AND status=?"
-        )
+        be assigned to that worker — the same guard ``finish_task`` has.
+
+        ``consume_retry=False`` is for infrastructure failures that are
+        not the task's fault (a stolen gang-coordinator port): the requeue
+        ignores the retry budget and leaves the counter untouched, so a
+        ``max_retries: 0`` task still recovers.  Callers must reserve it
+        for transient conditions a fresh attempt actually fixes — it can
+        loop forever on a persistent one."""
+        if consume_retry:
+            q = (
+                "UPDATE tasks SET status=?, worker=NULL, started=NULL,"
+                " retries=retries+1 WHERE id=? AND retries < max_retries"
+                " AND status=?"
+            )
+        else:
+            # the counter increments INSIDE the requeue UPDATE so the cap
+            # (infra_requeue_count) can never miss a bypass to a crash
+            # between two transactions
+            q = (
+                "UPDATE tasks SET status=?, worker=NULL, started=NULL,"
+                " infra_requeues=infra_requeues+1 WHERE id=? AND status=?"
+            )
         params: list = [
             TaskStatus.QUEUED.value,
             task_id,
@@ -594,6 +629,17 @@ class Store:
                 # a re-queued multi-host task re-gathers a fresh gang
                 c.execute("DELETE FROM gang WHERE task_id=?", (task_id,))
             return cur.rowcount == 1
+
+    def infra_requeue_count(self, task_id: int) -> int:
+        """How many times this task was requeued without consuming a retry
+        (a dedicated column incremented atomically inside the requeue
+        UPDATE, so the cap holds across workers and worker restarts — a
+        per-worker counter would multiply the max_retries bypass by the
+        worker count)."""
+        row = self._conn.execute(
+            "SELECT infra_requeues FROM tasks WHERE id=?", (task_id,)
+        ).fetchone()
+        return int(row["infra_requeues"]) if row is not None else 0
 
     # ------------------------------------------------------------- gang claims
     #
@@ -719,6 +765,31 @@ class Store:
                 "UPDATE gang SET worker=NULL WHERE task_id=? AND slot=?"
                 " AND worker=?",
                 (task_id, slot, worker),
+            )
+            return cur.rowcount == 1
+
+    def release_gang_slot_if_dormant(
+        self, task_id: int, slot: int, worker: str
+    ) -> bool:
+        """Give a slot back ONLY while the gang is dormant: some slot still
+        unheld, or the task no longer runnable.  The viability check and
+        the release are ONE transaction — a bail path that reads "not
+        filled" and then releases in a second tx can release after the
+        gang fills, launching a gang whose member never comes (the child
+        hangs in collectives until the supervisor requeues it, burning a
+        retry).  False = the gang went live under us; the caller should
+        join it instead of walking away."""
+        with self._tx() as c:
+            cur = c.execute(
+                "UPDATE gang SET worker=NULL WHERE task_id=? AND slot=?"
+                " AND worker=? AND NOT ("
+                " (SELECT COUNT(*) FROM gang WHERE task_id=?"
+                "  AND worker IS NULL)=0"
+                " AND (SELECT status FROM tasks WHERE id=?) IN (?,?))",
+                (
+                    task_id, slot, worker, task_id, task_id,
+                    TaskStatus.QUEUED.value, TaskStatus.IN_PROGRESS.value,
+                ),
             )
             return cur.rowcount == 1
 

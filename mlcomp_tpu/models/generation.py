@@ -149,13 +149,13 @@ def generate(
     # - default (storage): dequantize ONCE at entry, decode runs bf16.
     #   In-scan jnp dequant was measured SLOWER than bf16 (XLA
     #   materializes the dequantized copy per token).
-    # - ``quant_kernel=True``: keep kernel-consumable 2-D leaves int8 and
-    #   route their Dense/Embed ops through the Pallas int8 matmul
-    #   (ops/pallas/quant_matmul.py) — the dequant happens in VMEM, so
-    #   those weights (mlp + lm_head + embed ≈ 80% of a decoder's bytes)
-    #   cost HALF the HBM read per token.  3-D attention projections
-    #   still dequantize at entry (their per-channel scales don't factor
-    #   out of the contraction).
+    # - ``quant_kernel=True``: keep kernel-consumable leaves int8 and
+    #   route their Dense/DenseGeneral/Embed ops through the Pallas int8
+    #   matmul (ops/pallas/quant_matmul.py) — the dequant happens in
+    #   VMEM, so those weights cost HALF the HBM read per token.  Since
+    #   round 3 this includes the 3-D attention projections (folded to
+    #   2-D; quantize_params puts their scales on the true contraction
+    #   axes), so ~100% of decoder weight bytes stay int8.
     # Measured (v5e, 268M LM, 128 new tokens, interleaved medians,
     # ms/tok): B=4 bf16 1.74 / entry 1.63 / kernel 1.61; B=8 bf16 1.68 /
     # entry 1.60 / kernel 1.72.  The kernel wins only in the weight-

@@ -77,9 +77,17 @@ def quant_matmul(
     d2, n = q8.shape
     if d != d2:
         raise ValueError(f"contraction mismatch: x {x.shape} vs q8 {q8.shape}")
-    scale = scale.reshape(-1)[-n:] if scale.size == n else scale
+    # accept only per-output-channel layouts: (n,) or (1, n).  A scale
+    # that merely has n elements (e.g. a per-input-row (d, 1) on a square
+    # kernel) would silently produce wrong outputs — the kernel assumes
+    # scales commute with the contraction.
+    if scale.shape == (1, n):
+        scale = scale.reshape(n)
     if scale.shape != (n,):
-        raise ValueError(f"scale must be ({n},); got {scale.shape}")
+        raise ValueError(
+            f"scale must be per-output-channel, shape ({n},) or (1, {n}); "
+            f"got {scale.shape}"
+        )
     # largest preferred block that divides the dim — the SAME rule
     # kernel_consumable (ops/quant.py) checks against, so anything it
     # admits tiles here (any lane multiple works via the 128 fallback)

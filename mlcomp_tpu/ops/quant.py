@@ -23,7 +23,8 @@ No upstream analog (the reference has no inference quantization); usage:
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import math
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,18 +32,32 @@ import jax.numpy as jnp
 _QKEY = "q8"
 _SKEY = "q8_scale"
 
+# flax param-path naming of the 3-D DenseGeneral attention projections
+# (models/transformer.py, models/bert.py): q/k/v kernels are (d, H, dh)
+# contracting d; out kernels are (H, dh, d) contracting (H, dh).
+_ATTN_IN_KEYS = ("q", "k", "v", "query", "key", "value")
+_ATTN_OUT_KEYS = ("out", "o", "out_proj")
 
-def quantize_leaf(w: jax.Array) -> Dict[str, jax.Array]:
-    """Per-output-channel (last axis) absmax int8 quantization.
 
-    Only the input axis (``ndim-2``) is reduced: leading axes are treated
-    as stacked/batch axes, so a scanned per-layer stack ``(L, d_in,
-    d_out)`` gets independent ``(L, 1, d_out)`` scales — one shared scale
-    across layers would let the largest layer's weights crush the
-    resolution of the smallest's.  For 2-D matrices this is exactly the
-    classic per-channel scheme."""
+def quantize_leaf(
+    w: jax.Array, reduce_axes: Optional[Tuple[int, ...]] = None
+) -> Dict[str, jax.Array]:
+    """Per-output-channel absmax int8 quantization.
+
+    ``reduce_axes`` names the contraction (input) axes — the scale is
+    constant along them, so it factors out of any matmul against the
+    weight.  Default is ``(ndim-2,)``: leading axes are treated as
+    stacked/batch axes, so a scanned per-layer stack ``(L, d_in, d_out)``
+    gets independent ``(L, 1, d_out)`` scales — one shared scale across
+    layers would let the largest layer's weights crush the resolution of
+    the smallest's.  For 2-D matrices this is exactly the classic
+    per-channel scheme.  Attention projections pass their real
+    contraction axes (see :func:`quantize_params`): ``(0,)`` for a
+    (d, H, dh) q/k/v kernel, ``(0, 1)`` for an (H, dh, d) out kernel."""
+    if reduce_axes is None:
+        reduce_axes = (w.ndim - 2,)
     w32 = w.astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(w32), axis=w.ndim - 2, keepdims=True)
+    absmax = jnp.max(jnp.abs(w32), axis=tuple(reduce_axes), keepdims=True)
     scale = jnp.maximum(absmax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return {_QKEY: q, _SKEY: scale.astype(jnp.float32)}
@@ -56,25 +71,47 @@ def is_quantized_leaf(x: Any) -> bool:
     return isinstance(x, dict) and _QKEY in x and _SKEY in x
 
 
+def _attn_reduce_axes(path) -> Optional[Tuple[int, ...]]:
+    """Contraction axes for a 3-D attention-projection kernel, recognized
+    by its flax param path (``.../q/kernel`` etc. — the framework's
+    decoder and encoder attention modules all use these names).  Returns
+    None for anything else, which falls back to the stacked-axis default."""
+    if len(path) < 2 or getattr(path[-1], "key", None) != "kernel":
+        return None
+    parent = getattr(path[-2], "key", None)
+    if parent in _ATTN_IN_KEYS:
+        return (0,)       # (d, H, dh): contract d
+    if parent in _ATTN_OUT_KEYS:
+        return (0, 1)     # (H, dh, d): contract (H, dh)
+    return None
+
+
 def quantize_params(params, min_size: int = 4096):
     """Quantize every float matrix leaf with >= ``min_size`` elements.
 
     Returns a pytree of the same structure where quantized leaves became
     ``{"q8": int8, "q8_scale": f32}`` sub-dicts; everything else passes
-    through untouched.
+    through untouched.  3-D attention-projection kernels (recognized by
+    param path, see :func:`_attn_reduce_axes`) are quantized along their
+    true contraction axes so the scales factor out and the Pallas int8
+    kernel can consume them folded to 2-D; other ``ndim>=3`` leaves keep
+    the stacked-axis default (correct for entry dequant and for the MoE
+    per-expert slice path, any scale layout roundtrips exactly).
     """
+    from jax.tree_util import tree_map_with_path
 
-    def visit(leaf):
+    def visit(path, leaf):
         if (
             hasattr(leaf, "ndim")
             and leaf.ndim >= 2
             and jnp.issubdtype(leaf.dtype, jnp.floating)
             and leaf.size >= min_size
         ):
-            return quantize_leaf(leaf)
+            axes = _attn_reduce_axes(path) if leaf.ndim == 3 else None
+            return quantize_leaf(leaf, axes)
         return leaf
 
-    return jax.tree.map(visit, params)
+    return tree_map_with_path(visit, params)
 
 
 def dequantize_params(params, dtype=jnp.bfloat16):
@@ -88,17 +125,45 @@ def dequantize_params(params, dtype=jnp.bfloat16):
     )
 
 
+def folded_2d(leaf: Dict[str, jax.Array]) -> Optional[Tuple[int, int, int]]:
+    """If the leaf's scale is size-1 on a leading prefix of axes (the
+    contraction) and full-size on the rest (the output channels), the
+    scale factors out of the contraction and the kernel folds to a 2-D
+    ``(m, n)`` matmul operand — return ``(n_contract, m, n)``.  Covers
+    2-D Dense kernels (scale ``(1, n)``), 3-D q/k/v projections (scale
+    ``(1, H, dh)``), and 3-D out projections (scale ``(1, 1, d)``).
+    Returns None for stacked per-layer/per-expert scales like
+    ``(L, 1, d_out)`` — those don't factor out of a single matmul (the
+    MoE scan consumes them slice-wise instead, see expert_matmul)."""
+    q, s = leaf[_QKEY], leaf[_SKEY]
+    if s.ndim != q.ndim:
+        return None
+    j = 0
+    while j < q.ndim and s.shape[j] == 1:
+        j += 1
+    if j == 0 or j == q.ndim:
+        return None
+    if tuple(s.shape[j:]) != tuple(q.shape[j:]):
+        return None
+    return j, math.prod(q.shape[:j]), math.prod(q.shape[j:])
+
+
 def kernel_consumable(leaf: Dict[str, jax.Array]) -> bool:
     """True if the Pallas int8 matmul can consume this leaf directly:
-    2-D kernel, lane-tileable, with the scale constant along the
-    contraction axis (quantize_leaf's axis ``ndim-2`` reduce puts 2-D
-    scales on the output channel — exactly the factorable case).  3-D+
-    kernels (DenseGeneral attention projections, stacked layer params)
+    the scale factors out of the contraction (:func:`folded_2d`) and the
+    folded 2-D shape is lane-tileable.  2-D Dense kernels and 3-D
+    DenseGeneral attention projections (quantized along their true
+    contraction axes by :func:`quantize_params`) both qualify; 4-D+
+    leaves (conv kernels — no interception) and stacked layer params
     fall back to entry dequantization."""
     q = leaf[_QKEY]
-    return (
-        q.ndim == 2 and q.shape[0] % 128 == 0 and q.shape[1] % 128 == 0
-    )
+    if q.ndim > 3:
+        return False
+    folded = folded_2d(leaf)
+    if folded is None:
+        return False
+    _, m, n = folded
+    return m % 128 == 0 and n % 128 == 0
 
 
 def dequantize_nonkernel_params(params, dtype=jnp.bfloat16):
@@ -106,13 +171,21 @@ def dequantize_nonkernel_params(params, dtype=jnp.bfloat16):
     :func:`quant_kernel_interception` will consume, selected by the same
     rule the interceptor dispatches on — flax param naming:
 
-    - ``.../kernel`` with a tileable 2-D q8 (nn.Dense, and DenseGeneral
-      with a single contraction axis) → stays int8 for the matmul kernel;
+    - 2-D ``.../kernel`` with a factorable, tileable q8 (nn.Dense,
+      Dense-semantics DenseGeneral, opted-in custom modules) → stays
+      int8 for the matmul kernel;
+    - 3-D ``.../q|k|v|out/kernel`` attention projections (the SAME path
+      rule :func:`quantize_params` used to place their scales) → stay
+      int8 when tileable; the interceptor folds them to 2-D.  A custom
+      NON-DenseGeneral module using these exact param names would keep
+      an int8 leaf the interceptor can't consume — name such params
+      differently or skip ``quant_kernel`` (same corner as the 2-D
+      ``kernel`` note below);
     - ``.../embedding`` (nn.Embed) → stays int8 for the gather path,
       which is shape-agnostic (no tiling requirement);
-    - anything else (3-D attention projections, custom modules' params)
-      → dequantized here, so ``model.apply`` never meets a {"q8", ...}
-      dict it doesn't understand.
+    - anything else (stacked per-layer params, conv kernels, custom
+      modules' params) → dequantized here, so ``model.apply`` never
+      meets a {"q8", ...} dict it doesn't understand.
 
     A custom module with Dense semantics can opt into interception by
     setting ``quant_kernel_eligible = True`` as a class attribute (the LM
@@ -130,7 +203,12 @@ def dequantize_nonkernel_params(params, dtype=jnp.bfloat16):
         if key == "embedding":
             return leaf
         if key == "kernel" and kernel_consumable(leaf):
-            return leaf
+            q = leaf[_QKEY]
+            # 3-D kernels stay int8 only on the recognized attention
+            # paths — an arbitrary 3-D leaf that merely folds (e.g. a
+            # width-1 Conv kernel) has no interceptor to consume it
+            if q.ndim == 2 or _attn_reduce_axes(path) is not None:
+                return leaf
         if (
             key in ("experts_w1", "experts_w2")
             and leaf[_QKEY].ndim == 3
@@ -163,16 +241,22 @@ def expert_matmul(x, leaf: Dict[str, jax.Array], dtype) -> jax.Array:
 
 
 def quant_kernel_interception():
-    """Flax interception context: while active, ``nn.Dense`` / ``nn.Embed``
-    modules whose parameter is an int8-quantized leaf compute through the
-    Pallas kernel (ops/pallas/quant_matmul.py) instead of crashing on the
+    """Flax interception context: while active, ``nn.Dense`` /
+    ``nn.DenseGeneral`` / ``nn.Embed`` modules whose parameter is an
+    int8-quantized leaf compute through the Pallas kernel
+    (ops/pallas/quant_matmul.py) instead of crashing on the
     {"q8", "q8_scale"} dict.  Works on ANY model without model changes —
     the module tree is intercepted at apply time, so MoE and custom user
     models get the fast path for free wherever they use plain Dense/Embed.
 
-    Dense: ``out = quant_matmul(x, q8, scale)`` — dequant fused in VMEM,
-    halving the decode-critical HBM weight read.  The matmul runs in
-    bf16 with fp32 accumulation even for fp32-compute modules (lm_head):
+    Dense/DenseGeneral: ``out = quant_matmul(x, q8, scale)`` — dequant
+    fused in VMEM, halving the decode-critical HBM weight read.  3-D
+    attention projections fold to 2-D (``(d, H, dh) → (d, H·dh)`` for
+    q/k/v, ``(H, dh, d) → (H·dh, d)`` for out — contiguous trailing
+    contractions, so the reshape is free) and their scales, quantized
+    along the true contraction axes by :func:`quantize_params`, factor
+    out of the fold.  The matmul runs in bf16 with fp32 accumulation
+    even for fp32-compute modules (lm_head):
     that mantissa trade is inherent to int8 weights anyway.
     Embed: gather rows of q8 then scale (per-column scales are shared by
     every row, so the gather commutes with dequantization).
@@ -181,44 +265,66 @@ def quant_kernel_interception():
 
     from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
 
-    def dense_like(mod):
+    def contract_count(mod):
+        """How many trailing input axes this module contracts against the
+        leading axes of its kernel, or None if it isn't dense-like."""
         if type(mod) is nn.Dense:
-            return True
+            return 1
         # opt-in protocol for framework modules with Dense semantics
         # (y = x @ kernel [+ bias]) that aren't flax Dense — e.g. the
         # LM head module that exposes its kernel for the fused loss
         if getattr(type(mod), "quant_kernel_eligible", False):
-            return True
+            return 1
         if type(mod) is nn.DenseGeneral:
-            # a single trailing contraction axis and no batch dims is
-            # exactly Dense semantics (2-D kernel, features last)
             axis = mod.axis if isinstance(mod.axis, tuple) else (mod.axis,)
             batch = (
                 mod.batch_dims if isinstance(mod.batch_dims, tuple)
                 else (mod.batch_dims,)
             )
-            return axis == (-1,) and batch == ()
-        return False
+            # contiguous trailing contraction axes and no batch dims:
+            # kernel = (*contract_dims, *features) — foldable to 2-D.
+            # Covers Dense semantics (axis=(-1,)), the (d, H, dh) q/k/v
+            # projections, and the (H, dh, d) out projection (axis=(-2,-1))
+            n = len(axis)
+            if batch == () and tuple(axis) == tuple(range(-n, 0)):
+                return n
+        return None
 
     def interceptor(next_fun, args, kwargs, context):
         mod = context.module
         if context.method_name != "__call__":
             return next_fun(*args, **kwargs)
-        if dense_like(mod) and mod.has_variable("params", "kernel"):
+        nc = contract_count(mod)
+        if nc is not None and mod.has_variable("params", "kernel"):
             k = mod.get_variable("params", "kernel")
-            if is_quantized_leaf(k) and k[_QKEY].ndim == 2:
+            if is_quantized_leaf(k):
+                q, s = k[_QKEY], k[_SKEY]
                 x = args[0]
                 out_dtype = getattr(mod, "dtype", None) or x.dtype
-                if kernel_consumable(k):
-                    xs = x.shape
-                    x2 = x.reshape(-1, xs[-1]).astype(jnp.bfloat16)
+                feats = q.shape[nc:]
+                # the scale must be constant along every contracted axis
+                # to commute with the matmul; quantize_params guarantees
+                # this for Dense kernels and named attention projections
+                factorable = (
+                    s.ndim == q.ndim
+                    and all(s.shape[i] == 1 for i in range(nc))
+                    and tuple(s.shape[nc:]) == tuple(feats)
+                )
+                m = math.prod(q.shape[:nc])
+                n = math.prod(feats)
+                if factorable and m % 128 == 0 and n % 128 == 0:
+                    x2 = x.reshape(-1, m).astype(jnp.bfloat16)
                     out = quant_matmul(
-                        x2, k[_QKEY], k[_SKEY].reshape(-1)
-                    ).astype(out_dtype).reshape(*xs[:-1], -1)
-                else:  # odd shape: dequantize inline, still correct
-                    out = (
-                        x.astype(out_dtype)
-                        @ dequantize_leaf(k, out_dtype)
+                        x2, q.reshape(m, n), s.reshape(-1)
+                    ).astype(out_dtype).reshape(*x.shape[: x.ndim - nc], *feats)
+                else:  # odd shape/scale layout: dequantize inline, still correct
+                    out = jax.lax.dot_general(
+                        x.astype(out_dtype),
+                        dequantize_leaf(k, out_dtype),
+                        (
+                            (tuple(range(x.ndim - nc, x.ndim)), tuple(range(nc))),
+                            ((), ()),
+                        ),
                     )
                 if getattr(mod, "use_bias", False):
                     bias = mod.get_variable("params", "bias")

@@ -38,6 +38,37 @@ from mlcomp_tpu.parallel.mesh import AXES, MeshSpec
 DCN_OK_AXES = ("dp", "fsdp", "pp")
 
 
+class CoordinatorBindError(RuntimeError):
+    """The coordinator process cannot bind its published rendezvous port
+    (stolen between the gang gather and child start).  The worker treats
+    this marker as an infrastructure failure: the task is requeued
+    WITHOUT consuming a retry and the next gather publishes a fresh
+    port (scheduler/worker.py ``_finalize``)."""
+
+
+def _preflight_coordinator_bind(coordinator_address: str) -> None:
+    """Fail fast (and cleanly) when the coordinator port is taken: the
+    runtime's own bind failure is a hard crash ("Failed to add port to
+    server" + SIGSEGV, observed on jax 0.8 CPU), which would cost the
+    child its whole JAX startup and leave only a log tail to diagnose.
+    A bind probe with SO_REUSEADDR passes on our own just-released
+    held socket (scheduler/worker.py holds the port through the gather)
+    but catches a live thief."""
+    import socket
+
+    port = int(coordinator_address.rsplit(":", 1)[1])
+    probe = socket.socket()
+    try:
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        probe.bind(("", port))
+    except OSError as e:
+        raise CoordinatorBindError(
+            f"coordinator port {coordinator_address} is already taken: {e}"
+        ) from e
+    finally:
+        probe.close()
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -64,6 +95,11 @@ def init_distributed(
     )
     if coordinator_address is None and num_processes is None:
         return False  # single-process run; jax.devices() is already correct
+    if coordinator_address is not None and process_id == 0:
+        # only the process that will HOST the coordinator service probes;
+        # probing the coordinator's port number locally on other hosts
+        # would be meaningless (and can false-positive)
+        _preflight_coordinator_bind(coordinator_address)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

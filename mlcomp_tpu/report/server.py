@@ -413,10 +413,26 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         self._json({"error": "not found"}, code=404)
 
+    def _token_ok(self) -> bool:
+        """True when no token is configured or the request bears it."""
+        secret = os.environ.get("MLCOMP_TPU_REPORT_TOKEN", "")
+        if not secret:
+            return True
+        auth = self.headers.get("Authorization", "")
+        return hmac.compare_digest(auth, f"Bearer {secret}")
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         path = self.path.split("?", 1)[0]
         if path in ("/", "/index.html"):
+            # static shell only — every datum it shows comes from the
+            # token-checked API routes below (the page forwards ?token=
+            # as a bearer header on each fetch)
             self._send(200, _DASHBOARD.encode(), "text/html; charset=utf-8")
+            return
+        # a configured token guards READS too: task logs, metrics, and
+        # report payloads are as sensitive as the mutation routes
+        if not self._token_ok():
+            self._json({"error": "invalid or missing token"}, code=403)
             return
         self._dispatch(_ROUTES)
 
@@ -428,12 +444,9 @@ class _Handler(BaseHTTPRequestHandler):
         if not self.headers.get("X-Requested-With"):
             self._json({"error": "missing X-Requested-With header"}, code=403)
             return
-        secret = os.environ.get("MLCOMP_TPU_REPORT_TOKEN", "")
-        if secret:
-            auth = self.headers.get("Authorization", "")
-            if not hmac.compare_digest(auth, f"Bearer {secret}"):
-                self._json({"error": "invalid or missing token"}, code=403)
-                return
+        if not self._token_ok():
+            self._json({"error": "invalid or missing token"}, code=403)
+            return
         self._dispatch(_POST_ROUTES)
 
     # ---- route impls -----------------------------------------------------

@@ -63,10 +63,23 @@ def sync_code(
     code_src = args.get("code_src")
     if not code_src:
         return
+    import fcntl
+
     from mlcomp_tpu.io.sync import sync_dirs
 
     dest = os.path.join(workdir, "code")
-    copied, removed = sync_dirs(code_src, dest)
+    os.makedirs(workdir, exist_ok=True)
+    # serialize concurrent syncs into a SHARED workdir (localhost-degraded
+    # multi-host runs every gang slot against one dest; real multi-host
+    # has per-host workdirs): without the lock one child can import a file
+    # the other is mid-copying/removing.  Same-content syncs after the
+    # first are hash-incremental no-ops, so waiting is cheap.
+    with open(dest + ".lock", "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            copied, removed = sync_dirs(code_src, dest)
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
     if (copied or removed) and store is not None:
         store.log(
             task_id,
@@ -164,17 +177,26 @@ class Worker:
                 pid = int(
                     open(os.path.join(d, "owner.pid")).read().strip()
                 )
-                os.kill(pid, 0)  # raises if the owner is gone
-                continue  # live owner: leave it alone
             except (OSError, ValueError):
-                pass
-            try:
-                # pid-less dirs younger than a minute may be mid-creation
-                # by a concurrent worker (mkdtemp -> pid-file window)
-                if time.time() - os.path.getmtime(d) < 60.0:
+                pid = None  # missing/garbled pid file: age-gate below
+            if pid is not None:
+                try:
+                    os.kill(pid, 0)
+                    continue  # live owner: leave it alone
+                except ProcessLookupError:
+                    pass  # owner gone: sweep
+                except OSError:
+                    # PermissionError et al.: the pid EXISTS (e.g. another
+                    # user's worker sharing this workdir) — treat as live
                     continue
-            except OSError:
-                pass
+            else:
+                try:
+                    # pid-less dirs younger than a minute may be mid-creation
+                    # by a concurrent worker (mkdtemp -> pid-file window)
+                    if time.time() - os.path.getmtime(d) < 60.0:
+                        continue
+                except OSError:
+                    pass
             shutil.rmtree(d, ignore_errors=True)
 
     # ------------------------------------------------------------ heartbeats
@@ -239,6 +261,9 @@ class Worker:
             # THE TASK, not kill the worker loop (callers catch and route
             # into _finalize) — same contract as the in-process setup guard
             self._free_chip_ids |= set(ids)
+            if gang and gang.get("sock") is not None:
+                gang["sock"].close()
+                gang["sock"] = None
             raise
 
     def _spawn_child_inner(self, claim, gang, ids) -> Dict[str, Any]:
@@ -281,6 +306,13 @@ class Worker:
             env["MLCOMP_TPU_NUM_PROCESSES"] = str(gang["hosts"])
             env["MLCOMP_TPU_PROCESS_ID"] = str(gang["slot"])
         env.update(self.child_env)
+        if gang and gang.get("sock") is not None:
+            # release the held coordinator port at the last instant — the
+            # only remaining steal window is fork→bind inside the child,
+            # and the child's preflight turns even that into a clean
+            # no-retry-consumed requeue (see _finalize)
+            gang["sock"].close()
+            gang["sock"] = None
         log_fh = open(log_path, "wb")
         try:
             proc = subprocess.Popen(
@@ -386,6 +418,28 @@ class Worker:
             )
         else:
             self.store.log(claim["id"], "error", err or "unknown error")
+            if (
+                err
+                and "CoordinatorBindError" in err
+                and self.store.infra_requeue_count(claim["id"]) < 3
+            ):
+                # the coordinator port was stolen between gather and child
+                # bind (the preflight's deliberate marker — raw runtime
+                # crashes take the normal retry path): an infrastructure
+                # failure, not the task's fault — requeue WITHOUT
+                # consuming a retry; the fresh gather holds a fresh port.
+                # Capped at 3 per task (counted durably in the store) so a
+                # workload that merely prints the marker cannot bypass
+                # max_retries forever.
+                if self.store.requeue_task(
+                    claim["id"], expect_worker=self.name, consume_retry=False
+                ):
+                    self.store.log(
+                        claim["id"], "warning",
+                        f"worker {self.name}: coordinator port stolen; "
+                        f"requeued without consuming a retry",
+                    )
+                    return
             # expect_worker: if the task was stopped or reaped+re-claimed
             # while we ran, neither requeue nor fail must touch it
             if not self.store.requeue_task(claim["id"], expect_worker=self.name):
@@ -448,66 +502,115 @@ class Worker:
             slot_claim["task"], slot_claim["slot"], slot_claim["hosts"]
         )
         tid = task["id"]
+        sock = None
         if slot == 0:
+            # bind and HOLD the coordinator port through the whole gather:
+            # a port picked by bind-then-close can be stolen while the
+            # gang fills.  The held socket rides the gang dict and is
+            # released microseconds before the child binds it
+            # (_spawn_child_inner); if even that window is lost, the
+            # child fails fast (CoordinatorBindError preflight,
+            # parallel/distributed.py) and _finalize requeues without
+            # consuming a retry.
+            sock = socket.socket()
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(("", 0))
+            sock.listen(1)
             self.store.publish_coordinator(
-                tid, f"{_host_address()}:{_free_port()}"
+                tid, f"{_host_address()}:{sock.getsockname()[1]}"
             )
+
+        handed_off = []
+
         def ready(state, row):
             gang = {
                 "slot": slot,
                 "hosts": hosts,
                 "coordinator": state["coordinator"],
+                "sock": sock,
             }
+            handed_off.append(True)
             return {"claim": row, "gang": gang}
 
-        t_start = time.time()
-        deadline = t_start + self.gang_wait_s
-        while time.time() < deadline:
-            row = self.store.task_row(tid)
-            if row is None or row["status"] not in (
-                TaskStatus.QUEUED.value, TaskStatus.IN_PROGRESS.value
-            ):
-                break  # stopped / reaped away mid-gather
-            state = self.store.gang_state(tid)
-            if state["workers"].get(slot) != self.name:
-                return None  # slot was reaped from under us; nothing to release
-            if state["filled"] and state["coordinator"]:
-                if slot == 0:
-                    if row["status"] == TaskStatus.QUEUED.value and (
-                        not self.store.start_gang_task(tid, self.name)
-                    ):
-                        break  # lost to a stop; release below
-                elif row["status"] != TaskStatus.IN_PROGRESS.value:
-                    # wait for slot 0 to flip the task
-                    self.store.heartbeat(self.name, self.chips)
-                    time.sleep(0.2)
-                    continue
-                return ready(state, self.store.task_row(tid))
-            if (
-                time.time() - t_start > 10.0
-                and self.store.has_claimable_task(self.chips)
-            ):
-                # the gang had a fair gather window and still isn't full
-                # while runnable single-host work waits — don't starve it
-                # behind a gang that may never fill; bail and come back
-                break
-            self.store.heartbeat(self.name, self.chips)
-            time.sleep(0.2)
-        # deadline/bail: the gang may have completed in the race window —
-        # a slot holder walking away from an IN_PROGRESS gang would strand
-        # slot 0's child waiting on a process that never comes
-        row = self.store.task_row(tid)
-        state = self.store.gang_state(tid)
-        if (
-            row is not None
-            and row["status"] == TaskStatus.IN_PROGRESS.value
-            and state["workers"].get(slot) == self.name
-            and state["filled"]
-            and state["coordinator"]
-        ):
-            return ready(state, row)
-        self.store.release_gang_slot(tid, slot, self.name)
-        return None
+        try:
+            t_start = time.time()
+            deadline = t_start + self.gang_wait_s
+            while time.time() < deadline:
+                row = self.store.task_row(tid)
+                if row is None or row["status"] not in (
+                    TaskStatus.QUEUED.value, TaskStatus.IN_PROGRESS.value
+                ):
+                    break  # stopped / reaped away mid-gather
+                state = self.store.gang_state(tid)
+                if state["workers"].get(slot) != self.name:
+                    return None  # slot reaped from under us; nothing to release
+                if state["filled"] and state["coordinator"]:
+                    if slot == 0:
+                        if row["status"] == TaskStatus.QUEUED.value and (
+                            not self.store.start_gang_task(tid, self.name)
+                        ):
+                            break  # lost to a stop; release below
+                    elif row["status"] != TaskStatus.IN_PROGRESS.value:
+                        # wait for slot 0 to flip the task
+                        self.store.heartbeat(self.name, self.chips)
+                        time.sleep(0.2)
+                        continue
+                    return ready(state, self.store.task_row(tid))
+                if (
+                    time.time() - t_start > 10.0
+                    and self.store.has_claimable_task(self.chips)
+                ):
+                    # the gang had a fair gather window and still isn't full
+                    # while runnable single-host work waits — don't starve it
+                    # behind a gang that may never fill; bail and come back
+                    break
+                self.store.heartbeat(self.name, self.chips)
+                time.sleep(0.2)
+            # deadline/bail: the gang may have completed in the race window
+            # — a slot holder walking away from a live gang would strand
+            # the other children in collectives against a process that
+            # never comes.  The release is therefore CONDITIONAL (one store
+            # tx, release_gang_slot_if_dormant): a refused release means
+            # the gang went live between our last read and the release —
+            # join it.
+            patience = time.time() + max(10.0, self.gang_wait_s)
+            while True:
+                row = self.store.task_row(tid)
+                state = self.store.gang_state(tid)
+                if state["workers"].get(slot) != self.name:
+                    return None  # reaped from under us; nothing to release
+                live = (
+                    row is not None and state["filled"] and state["coordinator"]
+                )
+                if live and row["status"] == TaskStatus.IN_PROGRESS.value:
+                    return ready(state, self.store.task_row(tid))
+                if (
+                    live
+                    and slot == 0
+                    and row["status"] == TaskStatus.QUEUED.value
+                    and self.store.start_gang_task(tid, self.name)
+                ):
+                    return ready(state, self.store.task_row(tid))
+                if self.store.release_gang_slot_if_dormant(
+                    tid, slot, self.name
+                ):
+                    return None
+                if time.time() > patience:
+                    # unreachable in normal operation (a live gang either
+                    # starts or gets reaped); force the release rather than
+                    # hang the worker on a wedged gang
+                    self.store.log(
+                        tid, "warning",
+                        f"worker {self.name}: force-releasing gang slot "
+                        f"{slot} after {self.gang_wait_s:.0f}s live-gang wait",
+                    )
+                    self.store.release_gang_slot(tid, slot, self.name)
+                    return None
+                self.store.heartbeat(self.name, self.chips)
+                time.sleep(0.2)
+        finally:
+            if sock is not None and not handed_off:
+                sock.close()
 
     # ------------------------------------------------------------- main loops
 

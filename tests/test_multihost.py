@@ -79,6 +79,31 @@ def test_gang_release_and_reclaim(store):
     assert b["slot"] == 0
 
 
+def test_gang_dormant_release_refused_when_live(store):
+    """ADVICE r2 TOCTOU: once the gang is filled and the task is live, a
+    bailing slot holder must NOT be able to release its slot (that would
+    launch a gang whose member never comes) — the conditional release
+    refuses in one transaction; it succeeds again once the task leaves
+    the live states."""
+    _, tid = _submit_gang_task(store, hosts=2)
+    a = store.claim_gang_slot("w-a", free_chips=0)
+    # unfilled gang: dormant release works
+    assert store.release_gang_slot_if_dormant(tid, a["slot"], "w-a")
+    a = store.claim_gang_slot("w-a", free_chips=0)
+    b = store.claim_gang_slot("w-b", free_chips=0)
+    # filled + QUEUED (slot 0 about to flip): refused
+    assert not store.release_gang_slot_if_dormant(tid, b["slot"], "w-b")
+    assert store.start_gang_task(tid, "w-a")
+    # filled + IN_PROGRESS: refused
+    assert not store.release_gang_slot_if_dormant(tid, b["slot"], "w-b")
+    assert store.gang_state(tid)["filled"]
+    # task stopped: release allowed again
+    assert store.stop_task(tid)
+    # (stop clears gang rows; re-gather and check the unfilled case)
+    state = store.gang_state(tid)
+    assert state["workers"] == {}
+
+
 def test_gang_cleared_on_requeue_and_stop(store):
     _, tid = _submit_gang_task(store, hosts=2, max_retries=1)
     store.claim_gang_slot("w-a", free_chips=0)
@@ -221,6 +246,88 @@ def check(ctx):
     assert result == {"processes": 2, "devices": 16}
     # both slots spawned children; only slot 0 wrote the result
     assert "gang slot 0/2" in logs and "gang slot 1/2" in logs
+
+
+def test_stolen_coordinator_port_gang_recovers(store, tmp_path, monkeypatch):
+    """VERDICT r2 next#7: steal the coordinator port in the release→bind
+    window.  The slot-0 child must fail fast (CoordinatorBindError
+    preflight), the task requeue WITHOUT consuming a retry
+    (max_retries=0!), and the re-gathered gang — on a fresh held port —
+    succeed."""
+    import socket as socket_mod
+
+    helper = tmp_path / "src" / "sp_helper.py"
+    helper.parent.mkdir()
+    helper.write_text(
+        "import jax\n"
+        "def check(ctx):\n"
+        "    assert jax.process_count() == 2\n"
+        "    return {'processes': jax.process_count()}\n"
+    )
+    args = {
+        "target": "sp_helper:check",
+        "code_src": str(helper.parent),
+        "code_import": [],
+    }
+    dag_id, tid = _submit_gang_task(
+        store, hosts=2, executor="pyfunc", args=args, max_retries=0
+    )
+
+    thieves = []
+    orig = Worker._spawn_child_inner
+
+    def stealing_spawn(self, claim, gang, ids):
+        # first slot-0 spawn only: grab the port the instant the worker
+        # releases its hold, exactly the TOCTOU the hardening targets
+        if gang and gang["slot"] == 0 and gang.get("sock") and not thieves:
+            port = gang["sock"].getsockname()[1]
+            gang["sock"].close()
+            gang["sock"] = None
+            thief = socket_mod.socket()
+            thief.setsockopt(
+                socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1
+            )
+            thief.bind(("", port))
+            thief.listen(1)
+            thieves.append(thief)
+        return orig(self, claim, gang, ids)
+
+    monkeypatch.setattr(Worker, "_spawn_child_inner", stealing_spawn)
+    stop_evt = threading.Event()
+    threads = []
+    for i in range(2):
+        wd = tmp_path / f"w{i}"
+        wd.mkdir()
+        t = threading.Thread(
+            target=_run_worker_until,
+            args=(store.path, stop_evt),
+            kwargs={"name": f"sp-w{i}", "workdir": str(wd), "chips": 0},
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            row = store.task_row(tid)
+            if row["status"] in (TaskStatus.SUCCESS.value,
+                                 TaskStatus.FAILED.value):
+                break
+            time.sleep(0.5)
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=10)
+        for thief in thieves:
+            thief.close()
+    row = store.task_row(tid)
+    logs = "\n".join(l["message"] for l in store.task_logs(tid))
+    assert thieves, "the steal never fired — test harness broken"
+    assert row["status"] == TaskStatus.SUCCESS.value, (
+        f"status={row['status']} error={row['error']}\nlogs:\n{logs}"
+    )
+    assert row["retries"] == 0, row["retries"]
+    assert "requeued without consuming a retry" in logs, logs
 
 
 def test_local_runner_gangs_multihost_dag(tmp_path):

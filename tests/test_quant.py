@@ -156,6 +156,136 @@ def test_generate_quant_kernel_runs():
     np.testing.assert_array_equal(np.asarray(a[:, 4]), np.asarray(b[:, 4]))
 
 
+def test_attention_projections_stay_int8_and_match():
+    """Round 3: the 3-D q/k/v/out DenseGeneral kernels are quantized
+    along their true contraction axes, survive dequantize_nonkernel_params
+    as int8, and compute through interception to the same result as
+    entry dequantization."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.ops.quant import (
+        dequantize_nonkernel_params,
+        dequantize_params,
+        is_quantized_leaf,
+        quant_kernel_interception,
+        quantize_params,
+    )
+
+    # hidden=256, heads=2 -> d_head=128: q/k/v fold (256, 256), out folds
+    # (256, 256) — lane-tileable, so the Pallas path is exercised (the
+    # interpret path on CPU)
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 128, "hidden": 256,
+        "layers": 1, "heads": 2, "mlp_dim": 512, "dtype": "float32",
+    })
+    ids = jnp.asarray(np.random.RandomState(1).randint(1, 128, (2, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    qp = quantize_params(params, min_size=1024)
+
+    attn = qp["DecoderLayer_0"]["attn"]
+    for name, want_scale in [
+        ("q", (1, 2, 128)), ("k", (1, 2, 128)), ("v", (1, 2, 128)),
+        ("out", (1, 1, 256)),
+    ]:
+        leaf = attn[name]["kernel"]
+        assert is_quantized_leaf(leaf), name
+        assert leaf["q8_scale"].shape == want_scale, (name, leaf["q8_scale"].shape)
+
+    kept = dequantize_nonkernel_params(qp, jnp.float32)
+    for name in ("q", "k", "v", "out"):
+        assert is_quantized_leaf(kept["DecoderLayer_0"]["attn"][name]["kernel"]), (
+            f"{name} projection was dequantized at entry — should stay int8"
+        )
+
+    ref = model.apply({"params": dequantize_params(qp, jnp.float32)}, ids)
+    with quant_kernel_interception():
+        out = model.apply({"params": kept}, ids)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=0.05, rtol=0.05,
+    )
+
+
+def test_dense_general_3d_interception_with_bias():
+    """BERT-style DenseGeneral projections (use_bias=True) through the
+    interceptor: q-style (axis=-1, 3-D kernel) and out-style
+    (axis=(-2,-1)) both match the dequantized computation."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.ops.quant import (
+        dequantize_params,
+        quant_kernel_interception,
+        quantize_params,
+    )
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.DenseGeneral((2, 128), dtype=jnp.float32, name="q")(x)
+            return nn.DenseGeneral(
+                256, axis=(-2, -1), dtype=jnp.float32, name="out"
+            )(h)
+
+    m = Block()
+    x = jnp.asarray(np.random.RandomState(2).normal(size=(4, 256)), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    qp = quantize_params(params, min_size=1024)
+    assert qp["q"]["kernel"]["q8_scale"].shape == (1, 2, 128)
+    assert qp["out"]["kernel"]["q8_scale"].shape == (1, 1, 256)
+    ref = m.apply({"params": dequantize_params(qp, jnp.float32)}, x)
+    with quant_kernel_interception():
+        out = m.apply({"params": qp}, x)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=0.05, rtol=0.05,
+    )
+
+
+def test_mqa_single_kv_head_quant_decode():
+    """kv_heads=1 (MQA): the k/v kernels are (d, 1, dh) — the folded
+    shape is the same matrix under either axis grouping; generation with
+    quant_kernel=True stays consistent with entry dequant."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.generation import generate
+    from mlcomp_tpu.ops.quant import quantize_params
+    from mlcomp_tpu.train.state import init_model
+
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 128, "hidden": 256,
+        "layers": 1, "heads": 2, "kv_heads": 1, "mlp_dim": 512,
+        "dtype": "float32",
+    })
+    prompt = jnp.asarray(np.random.RandomState(5).randint(1, 128, (2, 4)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    q = {"params": quantize_params(params, min_size=1024)}
+    a = generate(model, q, prompt, 3)
+    b = generate(model, q, prompt, 3, quant_kernel=True)
+    assert a.shape == b.shape == (2, 7)
+    np.testing.assert_array_equal(np.asarray(a[:, 4]), np.asarray(b[:, 4]))
+
+
+def test_quant_matmul_rejects_non_channel_scale():
+    """ADVICE r2: a per-input-row (d, 1) scale on a square kernel must be
+    rejected, not silently misused."""
+    import pytest as _pytest
+
+    from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.normal(size=(2, 256)), jnp.bfloat16)
+    q8 = jnp.asarray(rs.randint(-127, 127, size=(256, 256)), jnp.int8)
+    bad = jnp.ones((256, 1), jnp.float32)
+    with _pytest.raises(ValueError, match="per-output-channel"):
+        quant_matmul(x, q8, bad)
+
+
 def test_moe_quantized_decode_matches_entry_dequant():
     """MoE generation with int8 expert weights consumed in the scan (the
     Pallas slice path) matches full-precision decoding closely and runs

@@ -125,6 +125,31 @@ def test_post_token_auth(served, monkeypatch):
     assert status == 200 and "reset_tasks" in body
 
 
+def test_get_token_auth(served, monkeypatch):
+    """ADVICE r2: a configured token guards GET data routes too (logs,
+    metrics, reports), not just mutations; the static dashboard shell
+    stays open (it holds no data)."""
+    import urllib.error
+
+    _, _, tid, port = served
+    monkeypatch.setenv("MLCOMP_TPU_REPORT_TOKEN", "s3cret")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, f"/api/tasks/{tid}/logs")
+    assert ei.value.code == 403
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/tasks/{tid}/logs",
+        headers={"Authorization": "Bearer s3cret"},
+    )
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+        assert json.loads(r.read())[0]["message"] == "hello from a"
+
+    # the HTML shell itself is served without the token
+    status, body = _get(port, "/")
+    assert status == 200 and b"token" in body
+
+
 def test_api_models(served, tmp_path, monkeypatch):
     from mlcomp_tpu.io.storage import ModelStorage
 
