@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Headline benchmarks: ResNet-50 img/s/chip + Transformer-LM tokens/s + MFU.
+"""Headline benchmarks: ResNet-50 img/s/chip, LM train tokens/s + MFU,
+LM decode tokens/s (serving), and scheduler tick latency at 10k tasks.
 
 Line 1 mirrors the reference's north-star metric (BASELINE.json:2 —
 "images/sec/chip on a ResNet-50 DAG").  The acceptance bar is >=90% of
@@ -224,13 +225,310 @@ def bench_lm() -> None:
         remat=remat,
     )
     mfu = model_f / step_time / V5E_BF16_PEAK
-    print(json.dumps({
+    line = {
         "metric": "transformer_lm_1p2b_s4096_tokens_per_sec_per_chip",
         "value": round(toks_per_chip, 1),
         "unit": "tokens/sec/chip",
         "mfu": round(mfu, 4),
-        "hfu": round(hw_f / step_time / V5E_BF16_PEAK, 4),
         "vs_baseline": round(mfu / MFU_BAR, 4),
+    }
+    if remat:
+        # hfu == mfu when no recompute runs; emit it only when it carries
+        # information (a reader seeing both identical may think recompute
+        # was measured)
+        line["hfu"] = round(hw_f / step_time / V5E_BF16_PEAK, 4)
+    print(json.dumps(line))
+
+
+DEC_PROMPT = int(os.environ.get("MLCOMP_BENCH_DEC_PROMPT", "2048"))
+DEC_NEW = int(os.environ.get("MLCOMP_BENCH_DEC_NEW", "256"))
+V5E_HBM_BW = 819e9  # bytes/s
+
+
+def bench_decode() -> None:
+    """Serving line (round-2 verdict ask): decode tokens/s on the SAME
+    1.2B model, S=2048 prompt + 256 generated, B in {1, 8}, int8 weights
+    consumed two ways: dequantized once at entry to bf16 ("bf16
+    pre-cast") vs read directly by the Pallas int8 kernel
+    (``quantize: "kernel"``, since round 3 covering the attention
+    projections too).
+
+    Decode time is isolated from prefill by the MARGINAL method: each
+    variant times generate() at 256 and at 128 new tokens (two compiles
+    of the same scan program at different trip counts) — the difference
+    is 128 pure decode steps; prefill, sampling setup, and dispatch
+    overheads cancel.  All variants interleave inside each measurement
+    round (tunnel drift is slower than a round), median of WINDOWS
+    rounds.
+
+    ``vs_baseline``: decode is HBM-bound, and the reference publishes no
+    serving numbers (it has no inference stack), so the bar is the
+    hardware roofline: bytes actually resident per step (weights at the
+    variant's dtype + the KV-cache read, which DOMINATES at B=8) over
+    v5e's 819 GB/s.  vs_baseline = measured/roofline utilization for the
+    headline (best-B=8) variant — ~0.90 measured, i.e. decode runs at
+    ~90% of what the memory system can theoretically deliver."""
+    from functools import partial
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.generation import generate
+    from mlcomp_tpu.ops.quant import quantize_params
+    from mlcomp_tpu.train.state import init_model
+
+    model = create_model({
+        "name": "transformer_lm",
+        "vocab_size": LM_VOCAB,
+        "hidden": LM_HIDDEN,
+        "layers": LM_LAYERS,
+        "heads": LM_HEADS,
+        "mlp_dim": 4 * LM_HIDDEN,
+        "dtype": "bfloat16",
+    })
+    gen = np.random.default_rng(2)
+    prompts = {
+        b: jnp.asarray(
+            gen.integers(1, LM_VOCAB, size=(b, DEC_PROMPT)), jnp.int32
+        )
+        for b in (1, 8)
+    }
+    params, _ = init_model(
+        model, {"x": prompts[1][:, :128]}, jax.random.PRNGKey(0)
+    )
+    qvars = {"params": quantize_params(params)}
+    del params  # one stored copy: int8 (+fp32 small leaves); the bf16
+    # variant dequantizes at entry INSIDE its jitted program
+
+    fns = {}
+    for b in (1, 8):
+        for mode in ("bf16", "int8"):
+            for n_new in (DEC_NEW // 2, DEC_NEW):
+                fns[(b, mode, n_new)] = jax.jit(
+                    partial(
+                        generate,
+                        model,
+                        max_new_tokens=n_new,
+                        quant_kernel=(mode == "int8"),
+                    )
+                )
+    for key, fn in fns.items():
+        b = key[0]
+        int(fn(qvars, prompts[b])[0, -1])  # compile + warm
+    times = {k: [] for k in fns}
+    for _ in range(WINDOWS):
+        for key, fn in fns.items():  # interleaved: one call per variant
+            b = key[0]
+            t0 = time.perf_counter()
+            out = fn(qvars, prompts[b])
+            int(out[0, -1])  # device->host fetch = completion barrier
+            times[key].append(time.perf_counter() - t0)
+
+    def med(key):
+        return statistics.median(times[key])
+
+    d = LM_HIDDEN
+    # per-step resident weight bytes.  The embedding table is EXCLUDED:
+    # decode gathers only B rows of it per step (jnp.take), so counting
+    # the full (V, d) table would flatter the utilization by ~2% at B=8.
+    # The head matmul does read its full (d, V) matrix every step.
+    weight_bytes_bf16 = sum(
+        int(np.prod(s)) for s in [
+            *[(d, d)] * 4 * LM_LAYERS,         # q/k/v/out
+            *[(d, 4 * d)] * 3 * LM_LAYERS,     # gate/up/down
+            (d, LM_VOCAB),                     # head
+        ]
+    ) * 2
+    kv_bytes = (DEC_PROMPT + DEC_NEW) * LM_LAYERS * 2 * d * 2  # per row
+    variants = {}
+    for b in (1, 8):
+        for mode in ("bf16", "int8"):
+            dt = med((b, mode, DEC_NEW)) - med((b, mode, DEC_NEW // 2))
+            n_tok = b * (DEC_NEW - DEC_NEW // 2)
+            w = weight_bytes_bf16 * (0.5 if mode == "int8" else 1.0)
+            roof = b * V5E_HBM_BW / (w + b * kv_bytes)
+            variants[f"b{b}_{mode}"] = {
+                "tokens_per_sec": round(n_tok / dt, 1),
+                "ms_per_token_per_seq": round(dt / n_tok * b * 1e3, 3),
+                "roofline_tokens_per_sec": round(roof, 1),
+            }
+    # headline: the best B=8 serving variant.  Measured on v5e at 1.2B the
+    # KV-cache read (2.4 GB/step at B=8, full-MHA S=2304) matches the
+    # weight read (2.3 GB bf16), so int8 weights shave only ~25% of step
+    # bytes while paying Pallas per-op overhead — bf16 wins at B=8 and
+    # int8 wins at B=1 (weights dominate there).  Both are reported; the
+    # winner is picked at runtime, not assumed.
+    head_key = max(
+        ("b8_bf16", "b8_int8"),
+        key=lambda k: variants[k]["tokens_per_sec"],
+    )
+    head = variants[head_key]
+    print(json.dumps({
+        "metric": "transformer_lm_1p2b_decode_tokens_per_sec_per_chip",
+        "value": head["tokens_per_sec"],
+        "unit": "tokens/sec/chip",
+        "prompt": DEC_PROMPT,
+        "generated": DEC_NEW,
+        "headline_variant": head_key,
+        "variants": variants,
+        "vs_baseline": round(
+            head["tokens_per_sec"] / head["roofline_tokens_per_sec"], 4
+        ),
+    }))
+
+
+def bench_longctx() -> None:
+    """Long-context single-chip evidence (r2 verdict next#8): a 268M LM
+    (d=1024, L=16) prefills a 16k-token prompt through the flash kernel
+    and decodes against the 16k KV cache.  Off by default
+    (MLCOMP_BENCH_LONGCTX=1 to run): it certifies the long-context story
+    fits and performs on ONE chip; the measured numbers are recorded in
+    SURVEY.md §2.  Prefill time comes from generate(max_new=8); decode
+    ms/tok from the marginal between 72 and 8 new tokens; peak HBM from
+    the runtime's allocator stats."""
+    from functools import partial
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.generation import generate
+    from mlcomp_tpu.train.state import init_model
+
+    S = int(os.environ.get("MLCOMP_BENCH_LONGCTX_S", "16384"))
+    model = create_model({
+        "name": "transformer_lm",
+        "vocab_size": LM_VOCAB,
+        "hidden": 1024,
+        "layers": 16,
+        "heads": 8,
+        "mlp_dim": 4096,
+        "dtype": "bfloat16",
+    })
+    gen = np.random.default_rng(3)
+    prompt = jnp.asarray(gen.integers(1, LM_VOCAB, size=(1, S)), jnp.int32)
+    params, _ = init_model(
+        model, {"x": prompt[:, :128]}, jax.random.PRNGKey(0)
+    )
+    variables = {"params": params}
+    fns = {
+        n: jax.jit(partial(generate, model, max_new_tokens=n,
+                           weights_dtype=jnp.bfloat16))
+        for n in (8, 72)
+    }
+    for fn in fns.values():
+        int(fn(variables, prompt)[0, -1])  # compile + warm
+    times = {n: [] for n in fns}
+    for _ in range(WINDOWS):
+        for n, fn in fns.items():
+            t0 = time.perf_counter()
+            int(fn(variables, prompt)[0, -1])
+            times[n].append(time.perf_counter() - t0)
+    t8 = statistics.median(times[8])
+    t72 = statistics.median(times[72])
+    decode_ms = (t72 - t8) / 64 * 1e3
+    peak_gb = None
+    stats = jax.local_devices()[0].memory_stats() or {}
+    if "peak_bytes_in_use" in stats:
+        peak_gb = round(stats["peak_bytes_in_use"] / 2**30, 2)
+    print(json.dumps({
+        "metric": "transformer_lm_268m_s16k_decode_ms_per_token",
+        "value": round(decode_ms, 3),
+        "unit": "ms/token",
+        "prompt": S,
+        "prefill_plus8_s": round(t8, 3),
+        "prefill_tokens_per_sec": round(S / t8, 1),
+        "peak_hbm_gb": peak_gb,
+        "vs_baseline": None,
+    }))
+
+
+SCHED_TASKS = int(os.environ.get("MLCOMP_BENCH_SCHED_TASKS", "10000"))
+SCHED_TICK_BAR_MS = 100.0  # "tick under 100 ms at 10k tasks" (r2 verdict)
+
+
+def bench_scheduler() -> None:
+    """Scheduler-scale line (BASELINE.json:2 — "DAG wall-clock scaling
+    8→256 chips" is bounded by how fast the supervisor can turn task
+    completions into new dispatches at grid-search scale).  A 10k-task
+    grid DAG (prep → 9,998 grid tasks → report, the shape
+    ``expand_grid`` produces): measures
+
+    - steady-state supervisor tick latency (nothing to transition — the
+      recurring cost every poll interval pays), native O(V+E) CSR core
+      (native/schedcore.cpp) vs the pure-Python graph walk;
+    - the one BIG dispatch tick that queues all 9,998 grid tasks;
+    - worker claim throughput (atomic conditional-UPDATE claims/s
+      against the store, the rate the whole worker fleet shares).
+
+    CPU-only (sqlite + the scheduler core; no TPU involvement).
+    ``vs_baseline`` = 100 ms bar / measured native steady-state tick."""
+    import tempfile
+
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.scheduler.supervisor import Supervisor
+
+    n_grid = SCHED_TASKS - 2
+    tasks = [TaskSpec(name="prep", executor="noop")]
+    tasks += [
+        TaskSpec(name=f"t{i}", executor="noop", depends=("prep",))
+        for i in range(n_grid)
+    ]
+    tasks.append(
+        TaskSpec(
+            name="report",
+            executor="noop",
+            depends=tuple(f"t{i}" for i in range(n_grid)),
+        )
+    )
+    dag = DagSpec(name="sched_bench", project="bench", tasks=tuple(tasks))
+
+    db = tempfile.mktemp(prefix="mlcomp_sched_bench_", suffix=".sqlite")
+    store = Store(db)
+    dag_id = store.submit_dag(dag)
+    sup = Supervisor(store)
+    sup.tick()  # queues prep
+    store.set_task_status(dag_id, ["prep"], TaskStatus.SUCCESS)
+
+    t0 = time.perf_counter()
+    sup.tick()  # the big dispatch: queues all n_grid tasks at once
+    dispatch_ms = (time.perf_counter() - t0) * 1e3
+
+    def steady_tick_ms(supervisor) -> float:
+        times = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            supervisor.tick()
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times) * 1e3
+
+    native_ms = steady_tick_ms(sup)
+
+    import mlcomp_tpu.native as native_mod
+
+    orig = native_mod.dag_analyze
+    native_mod.dag_analyze = lambda *a, **k: None  # force the Python walk
+    try:
+        python_ms = steady_tick_ms(Supervisor(store))  # fresh CSR cache
+    finally:
+        native_mod.dag_analyze = orig
+
+    claims = 0
+    t0 = time.perf_counter()
+    while claims < 2000:
+        if store.claim_task("bench-worker", free_chips=0) is None:
+            break
+        claims += 1
+    claim_dt = time.perf_counter() - t0
+    store.close()
+    os.unlink(db)
+
+    print(json.dumps({
+        "metric": "scheduler_tick_ms_at_10k_tasks",
+        "value": round(native_ms, 2),
+        "unit": "ms",
+        "tasks": SCHED_TASKS,
+        "python_tick_ms": round(python_ms, 2),
+        "native_speedup": round(python_ms / native_ms, 2),
+        "dispatch_tick_ms": round(dispatch_ms, 1),
+        "claims_per_sec": round(claims / claim_dt, 1),
+        "vs_baseline": round(SCHED_TICK_BAR_MS / native_ms, 4),
     }))
 
 
@@ -238,6 +536,12 @@ def main() -> None:
     bench_resnet()
     if os.environ.get("MLCOMP_BENCH_SKIP_LM", "") not in ("1", "true"):
         bench_lm()
+    if os.environ.get("MLCOMP_BENCH_SKIP_DECODE", "") not in ("1", "true"):
+        bench_decode()
+    if os.environ.get("MLCOMP_BENCH_SKIP_SCHED", "") not in ("1", "true"):
+        bench_scheduler()
+    if os.environ.get("MLCOMP_BENCH_LONGCTX", "") in ("1", "true"):
+        bench_longctx()  # opt-in: long-context evidence, SURVEY.md §2
 
 
 if __name__ == "__main__":

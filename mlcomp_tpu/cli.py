@@ -136,6 +136,9 @@ def _cmd_supervisor(args: argparse.Namespace) -> int:
 
 
 def _cmd_worker(args: argparse.Namespace) -> int:
+    import signal
+    import threading
+
     from mlcomp_tpu.scheduler.worker import Worker
     from mlcomp_tpu.db.store import Store
 
@@ -147,7 +150,40 @@ def _cmd_worker(args: argparse.Namespace) -> int:
         isolate=not args.in_process,
         max_tasks=args.max_tasks,
     )
-    w.run_forever(poll_interval=args.poll)
+    # SIGTERM drains: running tasks finish, nothing new is claimed, then
+    # the loop returns — what `cli pool` sends on stop
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *a: stop.set())
+    w.run_forever(poll_interval=args.poll, stop_event=stop)
+    return 0
+
+
+def _cmd_pool(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.scheduler.pool import WorkerPool, parse_inventory
+
+    if bool(args.inventory) == bool(args.hosts):
+        print("error: pass exactly one of --inventory / --hosts",
+              file=sys.stderr)
+        return 2
+    if args.inventory:
+        with open(args.inventory) as f:
+            hosts = parse_inventory(f.read(), default_chips=args.chips)
+    else:
+        hosts = parse_inventory(
+            "\n".join(h.strip() for h in args.hosts.split(",")),
+            default_chips=args.chips,
+        )
+    pool = WorkerPool(
+        Store(args.db),
+        hosts,
+        db_path=args.db,
+        base_workdir=args.workdir,
+        launch_template=args.launch,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+    )
+    pool.run_forever(poll_interval=args.poll)
     return 0
 
 
@@ -231,6 +267,36 @@ def main(argv=None) -> int:
         help="max concurrent isolated tasks (default: max(1, chips))",
     )
     w.set_defaults(fn=_cmd_worker)
+
+    pl = sub.add_parser(
+        "pool",
+        help="provision worker daemons over a host inventory and keep"
+        " them alive (launch, heartbeat-watch, restart, drain on stop)",
+    )
+    pl.add_argument("--db", default="mlcomp.sqlite")
+    pl.add_argument(
+        "--inventory", default=None,
+        help="inventory file: one host per line, optional chips=N"
+        " workdir=PATH attrs; # comments",
+    )
+    pl.add_argument(
+        "--hosts", default=None,
+        help="inline inventory, comma-separated hosts (e.g."
+        " localhost,tpu-vm-0)",
+    )
+    pl.add_argument("--chips", type=int, default=0,
+                    help="default chips per host")
+    pl.add_argument("--workdir", default="pool",
+                    help="base dir for per-worker workdirs and logs")
+    pl.add_argument(
+        "--launch", default=None,
+        help="launch template override; placeholders {host} {python} {db}"
+        " {name} {chips} {workdir} (default: direct exec for localhost,"
+        " ssh -o BatchMode=yes for remote hosts)",
+    )
+    pl.add_argument("--heartbeat-timeout", type=float, default=30.0)
+    pl.add_argument("--poll", type=float, default=2.0)
+    pl.set_defaults(fn=_cmd_pool)
 
     r = sub.add_parser("report", help="run the report/UI HTTP server")
     r.add_argument("--db", default="mlcomp.sqlite")

@@ -97,6 +97,34 @@ def sync_code(
         importlib.import_module(mod)
 
 
+def _kill_surviving_child(scratch_dir: str) -> None:
+    """Kill a task child (and its process group) that outlived its dead
+    worker, identified by the ``child.pid`` file its worker recorded at
+    spawn.  Verifies the pid still runs this framework's child module
+    before signalling — pids recycle, and killing an innocent process
+    group would be far worse than leaking one orphan."""
+    import signal
+
+    try:
+        pid = int(open(os.path.join(scratch_dir, "child.pid")).read().strip())
+    except (OSError, ValueError):
+        return
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read()
+    except OSError:
+        return  # already gone (or no procfs — then we cannot verify: skip)
+    if b"mlcomp_tpu.scheduler.child" not in cmdline:
+        return  # pid was recycled by an unrelated process
+    try:
+        os.killpg(pid, signal.SIGKILL)  # children start their own session
+    except OSError:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("", 0))
@@ -149,6 +177,7 @@ class Worker:
         self.child_env = dict(child_env or {})
         self._free_chip_ids = set(range(chips))
         self._children: List[Dict[str, Any]] = []
+        self._adopt_orphaned_tasks()
         self._sweep_stale_scratch()
         if load_jax_executors:
             from mlcomp_tpu import executors
@@ -158,9 +187,44 @@ class Worker:
     def _sync_code(self, args: Dict[str, Any], task_id: int) -> None:
         sync_code(args, task_id, self.workdir, self.store)
 
+    def _adopt_orphaned_tasks(self) -> None:
+        """Requeue tasks still assigned to this worker NAME by a previous
+        incarnation (a daemon restarted under the same name — systemd or
+        `cli pool` restarts).  The old children died with the old
+        process, but the new daemon's heartbeats would mask the death
+        from the supervisor's reaper, leaving those tasks IN_PROGRESS
+        forever.  Worker names must be unique per live daemon — that is
+        already the claiming contract."""
+        orphans = self.store.tasks_on_worker(self.name)
+        for t in orphans:
+            if self.store.requeue_task(t["id"], expect_worker=self.name):
+                self.store.log(
+                    t["id"], "warning",
+                    f"worker {self.name}: requeued task orphaned by a "
+                    f"previous incarnation of this worker",
+                )
+            else:
+                self.store.finish_task(
+                    t["id"],
+                    TaskStatus.FAILED,
+                    error=f"worker {self.name!r} restarted mid-task and "
+                    f"retries were exhausted",
+                    expect_worker=self.name,
+                )
+        # UNCONDITIONALLY: the old incarnation may have died holding a
+        # gang slot of a still-QUEUED task (mid-gather) — that is not in
+        # tasks_on_worker (slot 0 owns the row, and only after start),
+        # and the new daemon's fresh heartbeats hide the death from the
+        # supervisor's reaper, so nobody else would ever free the slot
+        self.store.release_worker_gang_slots(self.name)
+
     def _sweep_stale_scratch(self) -> None:
         """Remove ``.task-*`` child scratch dirs orphaned by a worker
-        process that died mid-task (normal exits clean up inline).
+        process that died mid-task (normal exits clean up inline), after
+        killing any task child that OUTLIVED that worker — children are
+        plain subprocesses in their own session, so a SIGKILL'd worker
+        leaves them running, holding pinned chips, and racing whatever
+        the replacement worker spawns for the requeued task.
 
         A dir is only swept when its recorded owner pid is gone —
         concurrent workers sharing a workdir must not delete each other's
@@ -197,6 +261,7 @@ class Worker:
                         continue
                 except OSError:
                     pass
+            _kill_surviving_child(d)
             shutil.rmtree(d, ignore_errors=True)
 
     # ------------------------------------------------------------ heartbeats
@@ -315,16 +380,23 @@ class Worker:
             gang["sock"] = None
         log_fh = open(log_path, "wb")
         try:
+            # own session/process group: (a) killing the child can take
+            # its whole subtree (shell executors spawn grandchildren),
+            # (b) a replacement worker can reap a child that outlived a
+            # SIGKILL'd worker by pgid (see _sweep_stale_scratch)
             proc = subprocess.Popen(
                 [sys.executable, "-m", "mlcomp_tpu.scheduler.child", spec_path],
                 env=env,
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
                 cwd=self.workdir,
+                start_new_session=True,
             )
         except Exception:
             log_fh.close()
             raise
+        with open(os.path.join(scratch, "child.pid"), "w") as f:
+            f.write(str(proc.pid))
         self.store.log(
             claim["id"], "info",
             f"worker {self.name}: spawned child pid {proc.pid}"
@@ -376,11 +448,22 @@ class Worker:
     def _kill_child(self, child: Dict[str, Any], reason: str) -> None:
         self.store.log(child["claim"]["id"], "warning",
                        f"worker {self.name}: killing child ({reason})")
-        child["proc"].terminate()
+        import signal
+
+        def signal_group(sig, fallback):
+            # the child leads its own process group (start_new_session in
+            # _spawn_child_inner): signal the whole group so executor
+            # grandchildren (shell commands) die with it
+            try:
+                os.killpg(child["proc"].pid, sig)
+            except OSError:
+                fallback()
+
+        signal_group(signal.SIGTERM, child["proc"].terminate)
         try:
             child["proc"].wait(timeout=5.0)
         except subprocess.TimeoutExpired:
-            child["proc"].kill()
+            signal_group(signal.SIGKILL, child["proc"].kill)
 
     def _task_still_mine(self, child: Dict[str, Any]) -> bool:
         """False once the task was stopped or reaped away from this gang/
@@ -666,10 +749,11 @@ class Worker:
                            gang=gang)
             return False
 
-    def poll(self) -> bool:
+    def poll(self, claim_new: bool = True) -> bool:
         """One non-blocking scheduling step (isolated mode): reap finished
         children, kill stopped ones, then claim/spawn up to capacity.
-        Returns True if anything progressed."""
+        ``claim_new=False`` drains: running children are still tended but
+        no new work is taken.  Returns True if anything progressed."""
         progressed = False
         for child in list(self._children):
             if child["proc"].poll() is not None:
@@ -686,7 +770,7 @@ class Worker:
                 if not self._task_still_mine(child):
                     self._kill_child(child, "task stopped or reassigned")
         busy = sum(int(c["claim"]["chips"]) for c in self._children)
-        while len(self._children) < self.max_tasks:
+        while claim_new and len(self._children) < self.max_tasks:
             claim = self.store.claim_task(
                 self.name, free_chips=self.chips - busy
             )
@@ -695,7 +779,7 @@ class Worker:
             progressed = True
             if self._try_spawn(claim, None):
                 busy += int(claim["chips"])
-        if not self._children:
+        if claim_new and not self._children:
             # idle: offer this worker to a multi-host gang (the gather wait
             # blocks this loop for at most gang_wait_s)
             gathered = self._gather_gang()
@@ -708,11 +792,21 @@ class Worker:
         )
         return progressed
 
-    def run_forever(self, poll_interval: float = 0.5) -> None:
+    def run_forever(self, poll_interval: float = 0.5, stop_event=None) -> None:
+        """Main daemon loop.  ``stop_event`` (a threading.Event, set by the
+        CLI's SIGTERM handler) drains gracefully: finish running tasks,
+        claim nothing new, then return."""
+
+        def stopping() -> bool:
+            return stop_event is not None and stop_event.is_set()
+
         if not self.isolate:
-            while True:
-                if not self.run_once():
+            while not stopping():
+                if not self.run_once() and not stopping():
                     time.sleep(poll_interval)
+            return
         while True:
-            if not self.poll():
+            if stopping() and not self._children:
+                return
+            if not self.poll(claim_new=not stopping()):
                 time.sleep(poll_interval)

@@ -1,0 +1,156 @@
+"""Worker pool provisioner: inventory parsing, launch/restart/drain, and
+the r3 verdict integration criterion — two localhost "hosts" provisioned
+through the pool run a gang task end-to-end; killing a daemon mid-task
+gets it relaunched and the task retried to success."""
+
+import os
+import signal
+import time
+
+import pytest
+
+from mlcomp_tpu.dag.schema import DagSpec, ResourceSpec, TaskSpec, TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.scheduler.pool import (
+    LOCAL_TEMPLATE,
+    REMOTE_TEMPLATE,
+    WorkerPool,
+    parse_inventory,
+)
+from mlcomp_tpu.scheduler.supervisor import Supervisor
+
+
+def test_parse_inventory():
+    text = """
+    # fleet
+    localhost chips=4
+    tpu-vm-0 workdir=/mnt/w zone=us-central2
+    """
+    hosts = parse_inventory(text, default_chips=1)
+    assert hosts[0].host == "localhost" and hosts[0].chips == 4
+    assert hosts[1].host == "tpu-vm-0" and hosts[1].chips == 1
+    assert hosts[1].workdir == "/mnt/w"
+    assert hosts[1].attrs == {"zone": "us-central2"}
+    with pytest.raises(ValueError, match="key=value"):
+        parse_inventory("h bad-attr")
+    with pytest.raises(ValueError, match="at least one"):
+        WorkerPool(None, [])
+
+
+def test_default_templates_pick_by_host(tmp_path, tmp_db):
+    store = Store(tmp_db)
+    try:
+        pool = WorkerPool(
+            store,
+            parse_inventory("localhost chips=2\ntpu-vm-3"),
+            base_workdir=str(tmp_path),
+        )
+        local_cmd = " ".join(pool._render(pool._members[0]))
+        remote_cmd = " ".join(pool._render(pool._members[1]))
+        assert "ssh" not in local_cmd and "--chips 2" in local_cmd
+        assert remote_cmd.startswith("ssh -o BatchMode=yes tpu-vm-3 ")
+        assert "pool-1-tpu-vm-3" in remote_cmd
+        assert "{" not in local_cmd + remote_cmd  # every placeholder filled
+    finally:
+        store.close()
+
+
+def _submit_gang_sleep_dag(store, helper_dir, sleep_s, name="pool-mh"):
+    helper = helper_dir / "pool_helper.py"
+    helper.parent.mkdir(parents=True, exist_ok=True)
+    helper.write_text(
+        "import time\n"
+        "def check(ctx):\n"
+        f"    time.sleep({sleep_s})\n"
+        "    import jax\n"
+        "    assert jax.process_count() == 2\n"
+        "    return {'processes': jax.process_count()}\n"
+    )
+    dag = DagSpec(
+        name=name, project="t",
+        tasks=(TaskSpec(
+            name="mh", executor="pyfunc",
+            args={
+                "target": "pool_helper:check",
+                "code_src": str(helper.parent),
+                "code_import": [],
+            },
+            resources=ResourceSpec(hosts=2),
+            max_retries=1,
+        ),),
+    )
+    dag_id = store.submit_dag(dag)
+    store.set_task_status(dag_id, ["mh"], TaskStatus.QUEUED)
+    return dag_id, store.task_rows(dag_id)[0]["id"]
+
+
+def test_pool_provisions_gang_restarts_dead_daemon(tmp_path, tmp_db):
+    """Two localhost daemons via the pool; a hosts=2 gang task runs; one
+    daemon is SIGKILLed mid-task; the pool relaunches it and the retried
+    task completes."""
+    store = Store(tmp_db)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        "PYTHONPATH": os.pathsep.join(
+            p for p in (repo_root, os.environ.get("PYTHONPATH")) if p
+        ),
+    }
+    pool = WorkerPool(
+        store,
+        parse_inventory("localhost\nlocalhost"),
+        base_workdir=str(tmp_path / "pool"),
+        heartbeat_timeout_s=20.0,
+        restart_backoff_s=0.2,
+        env=env,
+    )
+    sup = Supervisor(store, worker_timeout_s=12.0)
+    dag_id, tid = _submit_gang_sleep_dag(store, tmp_path / "src", sleep_s=25)
+
+    killed = {}
+
+    def babysit(deadline, until):
+        while time.time() < deadline:
+            pool.poll_once()
+            sup.tick()
+            if until():
+                return True
+            time.sleep(0.4)
+        return False
+
+    try:
+        # phase 1: daemons come up, the gang launches, the task runs
+        assert babysit(
+            time.time() + 180,
+            lambda: store.task_row(tid)["status"]
+            == TaskStatus.IN_PROGRESS.value,
+        ), f"task never started: {store.task_row(tid)}"
+        assert pool.alive_count() == 2
+
+        # phase 2: SIGKILL one daemon mid-task (the task sleeps 25 s)
+        victim = pool._members[0]["proc"]
+        killed["pid"] = victim.pid
+        os.kill(victim.pid, signal.SIGKILL)
+        assert babysit(
+            time.time() + 60,
+            lambda: pool.alive_count() == 2
+            and pool._members[0]["proc"].pid != killed["pid"],
+        ), "dead daemon was not relaunched"
+        assert pool._members[0]["restarts"] >= 1
+
+        # phase 3: the reaped task retries on the refreshed pool and
+        # completes
+        assert babysit(
+            time.time() + 240,
+            lambda: store.task_row(tid)["status"]
+            in (TaskStatus.SUCCESS.value, TaskStatus.FAILED.value),
+        ), f"task never finished: {store.task_row(tid)}"
+        row = store.task_row(tid)
+        logs = "\n".join(l["message"] for l in store.task_logs(tid))
+        assert row["status"] == TaskStatus.SUCCESS.value, (
+            f"error={row['error']}\nlogs:\n{logs}"
+        )
+        assert row["retries"] >= 1, "the killed attempt should consume a retry"
+    finally:
+        pool.drain(timeout_s=30)
+        store.close()
+    assert pool.alive_count() == 0
