@@ -108,8 +108,13 @@ class WorkerPool:
         if not hosts:
             raise ValueError("pool needs at least one inventory host")
         self.store = store
-        self.db_path = db_path or store.path
-        self.base_workdir = base_workdir
+        # absolute paths before any template renders: a relative --db
+        # sent over ssh resolves against the REMOTE home dir, where
+        # sqlite silently creates a fresh empty database and the worker
+        # idles forever.  (Remote hosts must see these absolute paths on
+        # a shared mount — the provisioning contract in the module doc.)
+        self.db_path = os.path.abspath(db_path or store.path)
+        self.base_workdir = os.path.abspath(base_workdir)
         self.launch_template = launch_template
         self.python = python
         self.heartbeat_timeout_s = heartbeat_timeout_s

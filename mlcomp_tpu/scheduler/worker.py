@@ -187,6 +187,27 @@ class Worker:
     def _sync_code(self, args: Dict[str, Any], task_id: int) -> None:
         sync_code(args, task_id, self.workdir, self.store)
 
+    def _predecessor_running(self, task_id: int) -> bool:
+        """True when a previous same-name incarnation is STILL EXECUTING
+        this task: its scratch dir in the (shared, per-host) workdir
+        records the owning worker pid, and that pid is alive.  Guards
+        adoption against the double-daemon case — e.g. a restarted
+        `cli pool` whose SIGKILLed predecessor left its worker daemons
+        running — where requeueing would run the task twice concurrently
+        on the same chips."""
+        import glob
+
+        for d in glob.glob(os.path.join(self.workdir, f".task-{task_id}-*")):
+            try:
+                pid = int(open(os.path.join(d, "owner.pid")).read().strip())
+                os.kill(pid, 0)
+                return True  # ProcessLookupError below means truly gone
+            except ProcessLookupError:
+                continue
+            except (OSError, ValueError):
+                return True  # unreadable/EPERM: err on the live side
+        return False
+
     def _adopt_orphaned_tasks(self) -> None:
         """Requeue tasks still assigned to this worker NAME by a previous
         incarnation (a daemon restarted under the same name — systemd or
@@ -194,9 +215,21 @@ class Worker:
         process, but the new daemon's heartbeats would mask the death
         from the supervisor's reaper, leaving those tasks IN_PROGRESS
         forever.  Worker names must be unique per live daemon — that is
-        already the claiming contract."""
+        the claiming contract; if a task's previous owner process is
+        demonstrably still alive (see _predecessor_running), the task is
+        left alone rather than double-executed."""
         orphans = self.store.tasks_on_worker(self.name)
+        live_predecessor = False
         for t in orphans:
+            if self._predecessor_running(t["id"]):
+                live_predecessor = True
+                self.store.log(
+                    t["id"], "warning",
+                    f"worker {self.name}: previous incarnation still "
+                    f"executing this task; not adopting (duplicate "
+                    f"same-name daemons?)",
+                )
+                continue
             if self.store.requeue_task(t["id"], expect_worker=self.name):
                 self.store.log(
                     t["id"], "warning",
@@ -211,12 +244,14 @@ class Worker:
                     f"retries were exhausted",
                     expect_worker=self.name,
                 )
-        # UNCONDITIONALLY: the old incarnation may have died holding a
-        # gang slot of a still-QUEUED task (mid-gather) — that is not in
-        # tasks_on_worker (slot 0 owns the row, and only after start),
-        # and the new daemon's fresh heartbeats hide the death from the
-        # supervisor's reaper, so nobody else would ever free the slot
-        self.store.release_worker_gang_slots(self.name)
+        # the old incarnation may also have died holding a gang slot of a
+        # still-QUEUED task (mid-gather) — not in tasks_on_worker (slot 0
+        # owns the row, and only after start), and the new daemon's fresh
+        # heartbeats hide the death from the supervisor's reaper, so
+        # nobody else would ever free the slot.  Skipped only when a live
+        # predecessor was just detected (its gather must not be robbed).
+        if not live_predecessor:
+            self.store.release_worker_gang_slots(self.name)
 
     def _sweep_stale_scratch(self) -> None:
         """Remove ``.task-*`` child scratch dirs orphaned by a worker

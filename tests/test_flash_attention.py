@@ -225,6 +225,46 @@ def test_kv_bounds_grads_match_masked_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5)
 
 
+def test_bounded_scheduled_matches_rectangular(monkeypatch):
+    """r3: the compressed dynamic-grid bounded path (default) must equal
+    the rectangular pl.when path bit-for-bit on CPU (same block compute,
+    different iteration) — fwd and grads, GQA, multi-block windows,
+    including an empty-window row."""
+    from mlcomp_tpu.ops.pallas import flash_attention as fa
+
+    b, s = 4, 512
+    q = _rand((b, s, 4, 64), 30)
+    k = _rand((b, s, 2, 64), 31)
+    v = _rand((b, s, 2, 64), 32)
+    w = _rand((b, s, 4, 64), 33)
+    lo = jnp.asarray([0, 64, 200, 70], jnp.int32)
+    hi = jnp.asarray([512, 384, 200, 71], jnp.int32)  # row 2: EMPTY window
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa.flash_attention(q, k, v, kv_start=lo, kv_stop=hi,
+                               block_q=128, block_kv=128) * w
+        )
+
+    def run():
+        out = fa.flash_attention(q, k, v, kv_start=lo, kv_stop=hi,
+                                 block_q=128, block_kv=128)
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return out, g
+
+    monkeypatch.setenv("MLCOMP_FLASH_BOUNDED_SCHED", "0")
+    out_rect, g_rect = run()
+    monkeypatch.setenv("MLCOMP_FLASH_BOUNDED_SCHED", "1")
+    out_sched, g_sched = run()
+    np.testing.assert_array_equal(np.asarray(out_rect), np.asarray(out_sched))
+    for a, b_ in zip(g_rect, g_sched):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    # the empty-window row outputs exact zeros on both paths
+    np.testing.assert_array_equal(
+        np.asarray(out_sched[2]), np.zeros_like(np.asarray(out_sched[2]))
+    )
+
+
 def test_kv_stop_only_right_padding():
     """kv_stop alone (BERT-style right padding) via the dispatch layer."""
     from mlcomp_tpu.ops.attention import dot_product_attention

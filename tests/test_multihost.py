@@ -224,7 +224,7 @@ def check(ctx):
         t.start()
         threads.append(t)
     try:
-        deadline = time.time() + 180
+        deadline = time.time() + 300
         while time.time() < deadline:
             row = store.task_row(tid)
             if row["status"] in (TaskStatus.SUCCESS.value,
@@ -307,7 +307,7 @@ def test_stolen_coordinator_port_gang_recovers(store, tmp_path, monkeypatch):
         t.start()
         threads.append(t)
     try:
-        deadline = time.time() + 180
+        deadline = time.time() + 300
         while time.time() < deadline:
             row = store.task_row(tid)
             if row["status"] in (TaskStatus.SUCCESS.value,
