@@ -194,6 +194,67 @@ def _cmd_report(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_average(args: argparse.Namespace) -> int:
+    from mlcomp_tpu.io.checkpoint import average_checkpoints
+
+    weights = None
+    if args.weights:
+        weights = [float(w) for w in args.weights.split(",")]
+    path = average_checkpoints(args.sources, args.out, weights=weights)
+    print(json.dumps({"averaged": len(args.sources), "out": path}))
+    return 0
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    import yaml
+
+    from mlcomp_tpu.serve import load_service, resolve_storage_ckpt, serve_http
+
+    with open(args.model) as f:
+        doc = yaml.safe_load(f)
+    # accept either a bare model mapping or a DAG/train YAML with a
+    # top-level ``model:`` anchor (the common case: point at the same
+    # file you trained from)
+    model_cfg = doc.get("model", doc) if isinstance(doc, dict) else doc
+    if not args.ckpt and not args.storage_task:
+        # serving random init silently would look healthy and emit junk
+        print("error: pass --ckpt or --storage-task (a checkpoint to"
+              " serve)", file=sys.stderr)
+        return 2
+    ckpt = args.ckpt
+    if not ckpt:
+        parts = args.storage_task.split("/")
+        if len(parts) != 3:
+            print(f"error: --storage-task must be PROJECT/DAG/TASK, got"
+                  f" {args.storage_task!r}", file=sys.stderr)
+            return 2
+        ckpt = resolve_storage_ckpt(*parts)
+    service = load_service(
+        model_cfg,
+        ckpt_dir=ckpt,
+        batch_sizes=tuple(int(x) for x in args.batch_sizes.split(",")),
+        prompt_buckets=tuple(int(x) for x in args.prompt_buckets.split(",")),
+        max_new_buckets=tuple(
+            int(x) for x in args.max_new_buckets.split(",")
+        ),
+        batch_window_ms=args.batch_window_ms,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        eos_id=args.eos_id,
+        pad_id=args.pad_id,
+        quantize=args.quantize or False,
+    )
+    if args.warmup:
+        n = service.warmup()
+        print(json.dumps({"event": "warmup", "programs": n}), flush=True)
+    serve_http(
+        service, host=args.host, port=args.port,
+        model_name=str(model_cfg.get("name", "model")),
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="mlcomp-tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -303,6 +364,53 @@ def main(argv=None) -> int:
     r.add_argument("--host", default="127.0.0.1")
     r.add_argument("--port", type=int, default=8765)
     r.set_defaults(fn=_cmd_report)
+
+    av = sub.add_parser(
+        "average",
+        help="weight-space average of checkpoints (SWA / model soup);"
+        " saves a weights-only checkpoint restorable by eval/infer/serve",
+    )
+    av.add_argument("sources", nargs="+", metavar="DIR[:STEP]",
+                    help="checkpoint dirs (latest step unless :STEP given)")
+    av.add_argument("--out", required=True, help="output checkpoint dir")
+    av.add_argument("--weights", default=None,
+                    help="comma-separated per-source weights (normalized)")
+    av.set_defaults(fn=_cmd_average)
+
+    sv = sub.add_parser(
+        "serve",
+        help="serve an LM checkpoint over HTTP: KV-cache decode,"
+        " micro-batched, bucketed static shapes (POST /generate)",
+    )
+    sv.add_argument(
+        "--model", required=True,
+        help="YAML with the model config (a bare mapping, or any DAG"
+        " YAML with a top-level 'model:' section)",
+    )
+    sv.add_argument("--ckpt", default=None, help="checkpoint directory")
+    sv.add_argument(
+        "--storage-task", default=None, metavar="PROJECT/DAG/TASK",
+        help="resolve the checkpoint from ModelStorage instead of --ckpt",
+    )
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8900)
+    sv.add_argument("--batch-sizes", default="1,2,4,8")
+    sv.add_argument("--prompt-buckets", default="128,256,512,1024")
+    sv.add_argument("--max-new-buckets", default="32,128")
+    sv.add_argument("--batch-window-ms", type=float, default=10.0)
+    sv.add_argument("--temperature", type=float, default=0.0)
+    sv.add_argument("--top-k", type=int, default=None)
+    sv.add_argument("--top-p", type=float, default=None)
+    sv.add_argument("--eos-id", type=int, default=None)
+    sv.add_argument("--pad-id", type=int, default=0)
+    sv.add_argument(
+        "--quantize", default=None, choices=("int8", "kernel"),
+        help="int8 weight-only: storage ('int8', entry dequant) or the"
+        " Pallas kernel path ('kernel', best at B=1)",
+    )
+    sv.add_argument("--warmup", action="store_true",
+                    help="precompile the hot buckets before listening")
+    sv.set_defaults(fn=_cmd_serve)
 
     args = p.parse_args(argv)
     from mlcomp_tpu.dag.graph import DagValidationError

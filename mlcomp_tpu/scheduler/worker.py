@@ -201,11 +201,19 @@ class Worker:
             try:
                 pid = int(open(os.path.join(d, "owner.pid")).read().strip())
                 os.kill(pid, 0)
-                return True  # ProcessLookupError below means truly gone
             except ProcessLookupError:
-                continue
+                continue  # truly gone
             except (OSError, ValueError):
                 return True  # unreadable/EPERM: err on the live side
+            # alive — but pids recycle: only a process actually running
+            # this framework counts as a live predecessor (same guard as
+            # _kill_surviving_child)
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    if b"mlcomp_tpu" in f.read():
+                        return True
+            except OSError:
+                return True  # no procfs: cannot disprove — err live
         return False
 
     def _adopt_orphaned_tasks(self) -> None:

@@ -1,0 +1,121 @@
+"""Checkpoint averaging (SWA / model soup): exact means, weighting, EMA
+preference, step selection, eval-path restorability, CLI surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlcomp_tpu.io.checkpoint import (
+    average_checkpoints,
+    read_weights,
+    save_checkpoint,
+)
+
+
+def _tree(value, bn=0.0, step=1):
+    return {
+        "params": {"w": jnp.full((4, 4), value, jnp.float32),
+                   "b": jnp.full((4,), value * 2, jnp.float32)},
+        "model_state": {"batch_stats": {"mean": jnp.full((4,), bn)}},
+        "step": step,
+    }
+
+
+def test_average_uniform_and_weighted(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    save_checkpoint(a, _tree(1.0, bn=0.0, step=3), step=3)
+    save_checkpoint(b, _tree(3.0, bn=2.0, step=7), step=7)
+
+    out = tmp_path / "avg"
+    average_checkpoints([str(a), str(b)], out)
+    got = read_weights(out)
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]), 2.0)
+    np.testing.assert_allclose(np.asarray(got["params"]["b"]), 4.0)
+    np.testing.assert_allclose(
+        np.asarray(got["model_state"]["batch_stats"]["mean"]), 1.0
+    )
+    assert got["step"] == 7  # max source step
+
+    out2 = tmp_path / "avg2"
+    average_checkpoints([str(a), str(b)], out2, weights=[3, 1])
+    got2 = read_weights(out2)
+    np.testing.assert_allclose(np.asarray(got2["params"]["w"]), 1.5)
+
+
+def test_average_prefers_ema_and_step_selection(tmp_path):
+    a = tmp_path / "a"
+    tree = _tree(1.0)
+    tree["ema_params"] = {"w": jnp.full((4, 4), 9.0, jnp.float32),
+                          "b": jnp.full((4,), 9.0, jnp.float32)}
+    save_checkpoint(a, tree, step=1)
+    b = tmp_path / "b"
+    save_checkpoint(b, _tree(1.0), step=1)
+    save_checkpoint(b, _tree(5.0), step=2)
+
+    out = tmp_path / "avg"
+    # EMA from a (9.0) + step-1 of b (1.0) -> 5.0
+    average_checkpoints([str(a), f"{b}:1"], out)
+    got = read_weights(out)
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]), 5.0)
+
+
+def test_average_validation(tmp_path):
+    a = tmp_path / "a"
+    save_checkpoint(a, _tree(1.0), step=1)
+    with pytest.raises(ValueError, match=">= 2"):
+        average_checkpoints([str(a)], tmp_path / "o")
+    b = tmp_path / "b"
+    save_checkpoint(b, {"params": {"other": jnp.ones((2,))},
+                        "model_state": {}, "step": 1}, step=1)
+    with pytest.raises(ValueError, match="different parameter structure"):
+        average_checkpoints([str(a), str(b)], tmp_path / "o")
+    with pytest.raises(ValueError, match="weights"):
+        average_checkpoints([str(a), str(a)], tmp_path / "o", weights=[1.0])
+
+
+def test_averaged_checkpoint_restores_through_eval_path(tmp_path):
+    """The averaged artifact must restore via restore_eval_state like any
+    train checkpoint (weights-only, EMA-free)."""
+    from mlcomp_tpu.io.checkpoint import restore_eval_state
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.train.optim import create_optimizer
+    from mlcomp_tpu.train.state import TrainState, init_model
+
+    model = create_model({"name": "mlp", "hidden": [16], "num_classes": 4})
+    x = jnp.zeros((1, 8))
+    params, mstate = init_model(model, {"x": x}, jax.random.PRNGKey(0))
+    tx = create_optimizer({"name": "sgd", "lr": 0.1})
+    state = TrainState.create(model.apply, params, tx, mstate)
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    save_checkpoint(
+        a, {"params": params, "model_state": mstate, "step": 1}, step=1
+    )
+    bumped = jax.tree.map(lambda p: p + 2.0, params)
+    save_checkpoint(
+        b, {"params": bumped, "model_state": mstate, "step": 2}, step=2
+    )
+    out = tmp_path / "avg"
+    average_checkpoints([str(a), str(b)], out)
+
+    restored = restore_eval_state(out, state)
+    expect = jax.tree.map(lambda p: p + 1.0, params)
+    for e, r in zip(jax.tree.leaves(expect), jax.tree.leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(e), rtol=1e-6)
+
+
+def test_cli_average(tmp_path, capsys):
+    from mlcomp_tpu.cli import main
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    save_checkpoint(a, _tree(0.0), step=1)
+    save_checkpoint(b, _tree(4.0), step=1)
+    rc = main([
+        "average", str(a), str(b), "--out", str(tmp_path / "avg"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"averaged": 2' in out
+    got = read_weights(tmp_path / "avg")
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]), 2.0)
