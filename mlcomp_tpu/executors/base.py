@@ -36,6 +36,10 @@ class ExecutionContext:
     # the same SPMD program and would write duplicate metric points; logs
     # stay on (prefixed by the child runner) for debuggability
     primary: bool = True
+    # the worker name this attempt runs under (from the claim row); lets
+    # long-running executors re-check ownership before side effects that
+    # could race a reassigned attempt (e.g. the preemption checkpoint)
+    worker: Optional[str] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def log(self, message: str, level: str = "info") -> None:

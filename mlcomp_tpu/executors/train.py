@@ -19,6 +19,22 @@ from typing import Any, Dict, Optional
 from mlcomp_tpu.executors.base import ExecutionContext, Executor
 
 
+def _still_owns_task(ctx: ExecutionContext) -> bool:
+    """True unless the store SHOWS this attempt lost the task (stopped,
+    or reassigned to another worker).  Store problems err toward True:
+    the preemption checkpoint is the feature, the stale-writer race is
+    the narrow exception — and a reassignment implies a reachable store."""
+    if ctx.store is None:
+        return True
+    try:
+        row = ctx.store.task_row(ctx.task_id)
+    except Exception:
+        return True
+    if row is None or row["status"] != "in_progress":
+        return False
+    return ctx.worker is None or row["worker"] == ctx.worker
+
+
 class TrainExecutor(Executor):
     name = "train"
 
@@ -146,12 +162,42 @@ class TrainExecutor(Executor):
                         f" -> {best_dir}"
                     )
 
+        from mlcomp_tpu.utils.preempt import TaskPreempted
+
         try:
-            final = trainer.fit(on_epoch=on_epoch)
-        finally:
-            writer.close()
-            if best_writer is not None:
-                best_writer.close()
+            try:
+                final = trainer.fit(on_epoch=on_epoch)
+            finally:
+                # writers close before any other manager touches these
+                # dirs (the preemption save below included)
+                writer.close()
+                if best_writer is not None:
+                    best_writer.close()
+        except TaskPreempted:
+            # checkpoint the consistent between-steps state so the
+            # requeued attempt resumes here instead of the last epoch
+            # boundary; then let the marker propagate — the worker
+            # requeues preempted tasks without consuming a retry.
+            # Ownership re-check first: the same SIGTERM also arrives
+            # when a STOPPED or REASSIGNED task's child is killed, and a
+            # stale attempt must not write into a checkpoint dir the
+            # task's new owner may be using concurrently.
+            if not _still_owns_task(ctx):
+                ctx.log(
+                    "preemption signal for a stopped/reassigned attempt; "
+                    "skipping the checkpoint",
+                    level="warning",
+                )
+                raise
+            cur = int(trainer.state.step)
+            if latest_step(ckpt_dir) != cur:
+                save_checkpoint(ckpt_dir, trainer.state, step=cur)
+            ctx.log(
+                f"preempted at step {cur}; checkpoint saved, task will "
+                f"resume on requeue",
+                level="warning",
+            )
+            raise
         if trainer.stopped_early is not None:
             ctx.log(f"early stop at epoch {trainer.stopped_early}")
         if trainer.trace_path:

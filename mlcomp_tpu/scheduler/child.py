@@ -39,6 +39,14 @@ def run_spec(spec_path: str) -> int:
     ok, result, err = False, None, None
     store = None
     try:
+        # SIGTERM = preemption notice (spot TPU-VM reclaim, pool drain):
+        # flag it so the train loop checkpoints and exits cleanly instead
+        # of dying mid-step; the worker requeues preempted tasks without
+        # consuming a retry (utils/preempt.py)
+        from mlcomp_tpu.utils.preempt import install_signal_handler
+
+        install_signal_handler()
+
         # distributed init must precede ANY jax use in executor code
         from mlcomp_tpu.parallel.distributed import init_distributed
 
@@ -72,6 +80,7 @@ def run_spec(spec_path: str) -> int:
             chips=claim["chips"],
             stage=claim["stage"],
             primary=process_id == 0,
+            worker=claim.get("worker"),
         )
         ok, result, err = run_task(claim["executor"], ctx)
     except Exception:

@@ -167,7 +167,12 @@ class Worker:
         self.store = store
         self.name = name or default_worker_name()
         self.chips = chips
-        self.workdir = workdir
+        # absolute, resolved ONCE here: children run with cwd=workdir,
+        # so relative scratch paths (and the --db default) would resolve
+        # against the wrong directory inside them; resolving at spawn
+        # time instead would break under a later chdir
+        self.workdir = os.path.abspath(workdir)
+        self._db_path = os.path.abspath(store.path)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.isolate = isolate
         # chips=0 workers (CPU hosts) still run one task at a time unless
@@ -177,6 +182,7 @@ class Worker:
         self.child_env = dict(child_env or {})
         self._free_chip_ids = set(range(chips))
         self._children: List[Dict[str, Any]] = []
+        os.makedirs(self.workdir, exist_ok=True)
         self._adopt_orphaned_tasks()
         self._sweep_stale_scratch()
         if load_jax_executors:
@@ -343,7 +349,7 @@ class Worker:
         self, busy_chips: int, stop: threading.Event, task_id: int
     ) -> None:
         """Own-connection heartbeat loop (sqlite connections are per-thread)."""
-        hb_store = Store(self.store.path)
+        hb_store = Store(self._db_path)
         try:
             while not stop.wait(self.heartbeat_interval_s):
                 hb_store.heartbeat(
@@ -386,7 +392,13 @@ class Worker:
         with open(os.path.join(scratch, "owner.pid"), "w") as f:
             f.write(str(os.getpid()))
         spec = {
-            "db": self.store.path,
+            # ABSOLUTE paths (normalized once in __init__): the child
+            # starts with cwd=workdir, so a relative --db (the CLI
+            # default) would silently open a fresh empty database there
+            # — the task would still run (claim rides in this spec,
+            # results ride the file below) but its logs and metrics
+            # would land in the wrong store
+            "db": self._db_path,
             "claim": claim,
             "workdir": self.workdir,
             "result": result_path,
@@ -544,27 +556,35 @@ class Worker:
             )
         else:
             self.store.log(claim["id"], "error", err or "unknown error")
-            if (
-                err
-                and "CoordinatorBindError" in err
-                and self.store.infra_requeue_count(claim["id"]) < 3
-            ):
-                # the coordinator port was stolen between gather and child
-                # bind (the preflight's deliberate marker — raw runtime
-                # crashes take the normal retry path): an infrastructure
-                # failure, not the task's fault — requeue WITHOUT
-                # consuming a retry; the fresh gather holds a fresh port.
-                # Capped at 3 per task (counted durably in the store) so a
-                # workload that merely prints the marker cannot bypass
-                # max_retries forever.
+            infra = None
+            if err and "CoordinatorBindError" in err:
+                infra = "coordinator port stolen"
+            elif err and "TaskPreempted" in err:
+                infra = "task preempted (spot reclaim/drain)"
+            if infra and self.store.infra_requeue_count(claim["id"]) < 3:
+                # infrastructure failures, not the task's fault — requeue
+                # WITHOUT consuming a retry: a stolen coordinator port
+                # (the preflight's deliberate marker; a fresh gather holds
+                # a fresh port) or a preemption notice (the train loop
+                # checkpointed; the requeued attempt resumes).  Capped at
+                # 3 per task (counted durably in the store) so a workload
+                # that merely prints a marker cannot bypass max_retries
+                # forever; preemption #4+ spends the normal budget.
                 if self.store.requeue_task(
                     claim["id"], expect_worker=self.name, consume_retry=False
                 ):
                     self.store.log(
                         claim["id"], "warning",
-                        f"worker {self.name}: coordinator port stolen; "
-                        f"requeued without consuming a retry",
+                        f"worker {self.name}: {infra}; requeued without "
+                        f"consuming a retry",
                     )
+                    # in-process attempts share this process's preemption
+                    # flag: clear it so the requeued attempt doesn't
+                    # instantly re-preempt off the stale signal (isolated
+                    # children get a fresh process and don't need this)
+                    from mlcomp_tpu.utils.preempt import clear
+
+                    clear()
                     return
             # expect_worker: if the task was stopped or reaped+re-claimed
             # while we ran, neither requeue nor fail must touch it
@@ -610,6 +630,7 @@ class Worker:
             workdir=self.workdir,
             chips=claim["chips"],
             stage=claim["stage"],
+            worker=self.name,
         )
         return run_task(claim["executor"], ctx)
 

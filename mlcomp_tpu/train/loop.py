@@ -273,6 +273,11 @@ class Trainer:
         return param_count(self.state.params)
 
     def train_epoch(self) -> Dict[str, float]:
+        from mlcomp_tpu.utils.preempt import (
+            TaskPreempted,
+            preemption_requested,
+        )
+
         agg: Dict[str, Any] = {}
         n = 0
         tracer = self.tracer if self.tracer is not None else get_tracer()
@@ -280,6 +285,14 @@ class Trainer:
         global_step = int(self.state.step) if self.profiler else 0
         it = iter(self._loader("train"))
         while True:
+            if preemption_requested():
+                # between steps, so state is a consistent post-step tree;
+                # the executor saves it and the worker requeues for free.
+                # The partial epoch restarts on resume (epoch accounting
+                # is step-count based) — at-least-once semantics.
+                raise TaskPreempted(
+                    f"preemption requested at step {int(self.state.step)}"
+                )
             # separate data/step spans: a fat "data" track means the input
             # pipeline starves the chips; a fat "step" means the host
             # blocked on dispatch (device queue full)

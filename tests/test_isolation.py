@@ -195,3 +195,52 @@ def test_concurrent_children_via_poll(store, tmp_path):
     # serial execution would need >= 2 sleeps of 2 s plus two interpreter
     # startups; concurrency keeps wall clock well under that
     assert time.time() - t0 < 25
+
+
+def test_child_logs_reach_store_with_relative_paths(tmp_path, monkeypatch):
+    """A worker given RELATIVE --db/--workdir (the CLI defaults) must
+    still deliver its children's ctx.log/metric writes to the right
+    store — the child runs with cwd=workdir, where a relative db path
+    would silently open a fresh empty database (found by a real CLI
+    drive; results rode the spec file so the bug only ate observability).
+    """
+    import os
+
+    from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.scheduler.worker import Worker
+
+    monkeypatch.chdir(tmp_path)
+    store = Store("rel.sqlite")  # deliberately relative
+    try:
+        helper = tmp_path / "src" / "rl_helper.py"
+        helper.parent.mkdir()
+        helper.write_text(
+            "def check(ctx):\n"
+            "    ctx.log('hello-from-child')\n"
+            "    ctx.metric('m', 1.5, step=0)\n"
+            "    return {'ok': True}\n"
+        )
+        dag = DagSpec(
+            name="rel", project="t",
+            tasks=(TaskSpec(name="a", executor="pyfunc", args={
+                "target": "rl_helper:check",
+                "code_src": str(helper.parent),
+                "code_import": [],
+            }),),
+        )
+        dag_id = store.submit_dag(dag)
+        store.set_task_status(dag_id, ["a"], TaskStatus.QUEUED)
+        w = Worker(store, name="rw", workdir="wk", isolate=True)  # relative
+        assert w.run_once() is True
+        tid = store.task_rows(dag_id)[0]["id"]
+        row = store.task_row(tid)
+        assert row["status"] == TaskStatus.SUCCESS.value, row["error"]
+        logs = "\n".join(l["message"] for l in store.task_logs(tid))
+        assert "hello-from-child" in logs
+        assert [list(p) for p in store.metric_series(tid, "m")] == [[0, 1.5]]
+        assert not os.path.exists(tmp_path / "wk" / "rel.sqlite"), (
+            "child opened a parallel database"
+        )
+    finally:
+        store.close()

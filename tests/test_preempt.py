@@ -1,0 +1,196 @@
+"""Preemption: SIGTERM → between-steps checkpoint → no-retry requeue →
+resumed completion."""
+
+import jax
+import numpy as np
+import pytest
+
+from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.scheduler.worker import Worker
+from mlcomp_tpu.utils import preempt
+
+
+@pytest.fixture(autouse=True)
+def _clear_flag():
+    preempt.clear()
+    yield
+    preempt.clear()
+
+
+def test_trainer_raises_between_steps():
+    from mlcomp_tpu.train.loop import Trainer
+
+    cfg = {
+        "model": {"name": "mlp", "hidden": [16], "num_classes": 4},
+        "optimizer": {"name": "sgd", "lr": 0.1},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "epochs": 1,
+        "data": {"train": {"name": "synthetic_classification", "n": 64,
+                           "dim": 8, "num_classes": 4, "batch_size": 16}},
+    }
+    tr = Trainer(cfg)
+    preempt.request_preemption()
+    with pytest.raises(preempt.TaskPreempted, match="step 0"):
+        tr.train_epoch()
+    preempt.clear()
+    stats = tr.train_epoch()
+    assert np.isfinite(stats["loss"])
+
+
+def _train_dag(store, tmp_path, epochs=2, **extra):
+    args = {
+        "model": {"name": "mlp", "hidden": [16], "num_classes": 4},
+        "optimizer": {"name": "sgd", "lr": 0.1},
+        "loss": "cross_entropy",
+        "metrics": [],
+        "epochs": epochs,
+        "data": {"train": {"name": "synthetic_classification", "n": 64,
+                           "dim": 8, "num_classes": 4, "batch_size": 16}},
+        "project": "t",
+        "dag_name": "pre",
+        **extra,
+    }
+    dag = DagSpec(
+        name="pre", project="t",
+        tasks=(TaskSpec(name="train", executor="train", args=args,
+                        max_retries=0),),
+    )
+    dag_id = store.submit_dag(dag)
+    store.set_task_status(dag_id, ["train"], TaskStatus.QUEUED)
+    return dag_id, store.task_rows(dag_id)[0]["id"]
+
+
+def test_preempted_train_requeues_free_and_resumes(tmp_path, tmp_db,
+                                                   monkeypatch):
+    """max_retries=0 train task: a preemption mid-run checkpoints,
+    requeues WITHOUT consuming a retry, and the second attempt resumes
+    from the checkpoint and succeeds."""
+    monkeypatch.setenv("MLCOMP_TPU_STORAGE", str(tmp_path / "storage"))
+    store = Store(tmp_db)
+    try:
+        _, tid = _train_dag(store, tmp_path)
+        w = Worker(store, name="pw", workdir=str(tmp_path / "wk"))
+
+        preempt.request_preemption()  # fires at the first step check
+        assert w.run_once() is True
+        row = store.task_row(tid)
+        assert row["status"] == TaskStatus.QUEUED.value, row["error"]
+        assert row["retries"] == 0
+        assert row["infra_requeues"] == 1
+        logs = "\n".join(l["message"] for l in store.task_logs(tid))
+        assert "task preempted" in logs and "checkpoint saved" in logs
+
+        preempt.clear()
+        assert w.run_once() is True
+        row = store.task_row(tid)
+        assert row["status"] == TaskStatus.SUCCESS.value, row["error"]
+        logs = "\n".join(l["message"] for l in store.task_logs(tid))
+        assert "resumed from checkpoint" in logs or "restored" in logs
+    finally:
+        store.close()
+
+
+def test_sigterm_to_isolated_child_preempts(tmp_path, tmp_db, monkeypatch):
+    """The REAL delivery path: an isolated task child gets SIGTERM (what
+    a spot reclaim or pool drain sends); the in-child handler flags, the
+    train loop checkpoints, and the task requeues without consuming its
+    (zero) retry budget, then completes on the next attempt."""
+    import os
+    import signal
+    import time
+
+    monkeypatch.setenv("MLCOMP_TPU_STORAGE", str(tmp_path / "storage"))
+    store = Store(tmp_db)
+    try:
+        _, tid = _train_dag(
+            store, tmp_path, epochs=2000, ckpt_every=500,
+            # meaty enough that 2000 epochs take minutes on one CPU core:
+            # the SIGTERM must land mid-training, not after completion.
+            # dp=1 keeps cross-device collectives out of the child — the
+            # 8-virtual-devices-on-one-core rendezvous can fatally time
+            # out under load, which is an environment flake, not the
+            # behavior under test
+            model={"name": "mlp", "hidden": [512, 512], "num_classes": 4},
+            data={"train": {"name": "synthetic_classification", "n": 4096,
+                            "dim": 256, "num_classes": 4,
+                            "batch_size": 32}},
+        )
+        w = Worker(
+            store, name="pw", workdir=str(tmp_path / "wk"), isolate=True,
+            # one virtual device in the child: no cross-device collectives
+            # (the 8-on-one-core rendezvous can fatally time out under
+            # load — an environment flake, not the behavior under test)
+            child_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"
+            },
+        )
+        # claim + spawn the child without blocking on completion
+        deadline = time.time() + 120
+        while not w._children and time.time() < deadline:
+            w.poll()
+            time.sleep(0.2)
+        assert w._children, "child never spawned"
+        child = w._children[0]
+        # wait for training to actually start (first epoch metric)
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if any("epoch 0" in l["message"] for l in store.task_logs(tid)):
+                break
+            time.sleep(0.5)
+        os.kill(child["proc"].pid, signal.SIGTERM)
+        # wait for the CHILD to exit before any worker poll: poll() would
+        # requeue AND immediately respawn in one call, racing the args
+        # edit below (the retry must run with lowered epochs)
+        child["proc"].wait(timeout=180)
+        # lower the bar so the resumed attempt finishes quickly
+        import json as _json
+
+        with store._tx() as c:
+            args = _json.loads(store.task_row(tid)["args"])
+            args["epochs"] = 1
+            c.execute("UPDATE tasks SET args=? WHERE id=?",
+                      (_json.dumps(args), tid))
+        w.poll()  # reap -> marker classification -> free requeue
+        row = store.task_row(tid)
+        assert row["retries"] == 0, row["error"]
+        assert row["infra_requeues"] == 1, (row["status"], row["error"])
+        logs = "\n".join(l["message"] for l in store.task_logs(tid))
+        assert "preempted at step" in logs and "checkpoint saved" in logs
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            w.poll()
+            row = store.task_row(tid)
+            if row["status"] in (TaskStatus.SUCCESS.value,
+                                 TaskStatus.FAILED.value):
+                break
+            time.sleep(0.3)
+        assert row["status"] == TaskStatus.SUCCESS.value, row["error"]
+        logs = "\n".join(l["message"] for l in store.task_logs(tid))
+        assert "resumed from checkpoint" in logs
+    finally:
+        store.close()
+
+
+def test_preemption_cap_falls_back_to_retry_budget(tmp_path, tmp_db,
+                                                   monkeypatch):
+    """After 3 free requeues the normal (exhausted) retry budget applies:
+    the task fails instead of looping forever."""
+    monkeypatch.setenv("MLCOMP_TPU_STORAGE", str(tmp_path / "storage"))
+    store = Store(tmp_db)
+    try:
+        _, tid = _train_dag(store, tmp_path)
+        w = Worker(store, name="pw", workdir=str(tmp_path / "wk"))
+        for i in range(3):
+            preempt.request_preemption()
+            assert w.run_once() is True
+            row = store.task_row(tid)
+            assert row["status"] == TaskStatus.QUEUED.value
+            assert row["infra_requeues"] == i + 1
+        preempt.request_preemption()
+        assert w.run_once() is True
+        row = store.task_row(tid)
+        assert row["status"] == TaskStatus.FAILED.value  # max_retries=0
+    finally:
+        store.close()
