@@ -285,7 +285,7 @@ def bench_decode() -> None:
     from mlcomp_tpu.ops.quant import quantize_params
     from mlcomp_tpu.train.state import init_model
 
-    model = create_model({
+    lm_cfg = {
         "name": "transformer_lm",
         "vocab_size": LM_VOCAB,
         "hidden": LM_HIDDEN,
@@ -293,7 +293,11 @@ def bench_decode() -> None:
         "heads": LM_HEADS,
         "mlp_dim": 4 * LM_HIDDEN,
         "dtype": "bfloat16",
-    })
+    }
+    model = create_model(lm_cfg)
+    # round-3: int8 KV cache (ops/pallas/decode_attention.py) — attacks
+    # the stream that measured DOMINANT at B=8 (the 2.4 GB/step KV read)
+    model_kv8 = create_model({**lm_cfg, "kv_quant": True})
     gen = np.random.default_rng(2)
     prompts = {
         b: jnp.asarray(
@@ -308,18 +312,29 @@ def bench_decode() -> None:
     del params  # one stored copy: int8 (+fp32 small leaves); the bf16
     # variant dequantizes at entry INSIDE its jitted program
 
+    # mode -> (model, quant_kernel): "kv8" = int8 KV cache + entry-dequant
+    # bf16 weights (B=8 only: that is where KV dominates); "kv8_int8" =
+    # everything int8 (KV cache + kernel-consumed weights), the
+    # minimum-bytes serving config, measured at both batch sizes
+    modes = {
+        "bf16": (model, False),
+        "int8": (model, True),
+        "kv8": (model_kv8, False),
+        "kv8_int8": (model_kv8, True),
+    }
+    combos = [
+        (b, mode)
+        for b in (1, 8)
+        for mode in ("bf16", "int8", "kv8", "kv8_int8")
+        if not (b == 1 and mode == "kv8")
+    ]
     fns = {}
-    for b in (1, 8):
-        for mode in ("bf16", "int8"):
-            for n_new in (DEC_NEW // 2, DEC_NEW):
-                fns[(b, mode, n_new)] = jax.jit(
-                    partial(
-                        generate,
-                        model,
-                        max_new_tokens=n_new,
-                        quant_kernel=(mode == "int8"),
-                    )
-                )
+    for b, mode in combos:
+        m, qk = modes[mode]
+        for n_new in (DEC_NEW // 2, DEC_NEW):
+            fns[(b, mode, n_new)] = jax.jit(
+                partial(generate, m, max_new_tokens=n_new, quant_kernel=qk)
+            )
     for key, fn in fns.items():
         b = key[0]
         int(fn(qvars, prompts[b])[0, -1])  # compile + warm
@@ -348,26 +363,33 @@ def bench_decode() -> None:
         ]
     ) * 2
     kv_bytes = (DEC_PROMPT + DEC_NEW) * LM_LAYERS * 2 * d * 2  # per row
+    # int8 cache: 1-byte K/V + per-(slot, head) f32 scales (~3% at
+    # dh=128); the full-buffer count matches what both paths read (XLA
+    # attends the whole masked buffer; the kernel clamps beyond the
+    # cursor, so this is conservative for it)
+    kv_bytes_int8 = (DEC_PROMPT + DEC_NEW) * LM_LAYERS * 2 * (
+        d + 4 * LM_HEADS
+    )
     variants = {}
-    for b in (1, 8):
-        for mode in ("bf16", "int8"):
-            dt = med((b, mode, DEC_NEW)) - med((b, mode, DEC_NEW // 2))
-            n_tok = b * (DEC_NEW - DEC_NEW // 2)
-            w = weight_bytes_bf16 * (0.5 if mode == "int8" else 1.0)
-            roof = b * V5E_HBM_BW / (w + b * kv_bytes)
-            variants[f"b{b}_{mode}"] = {
-                "tokens_per_sec": round(n_tok / dt, 1),
-                "ms_per_token_per_seq": round(dt / n_tok * b * 1e3, 3),
-                "roofline_tokens_per_sec": round(roof, 1),
-            }
+    for b, mode in combos:
+        dt = med((b, mode, DEC_NEW)) - med((b, mode, DEC_NEW // 2))
+        n_tok = b * (DEC_NEW - DEC_NEW // 2)
+        w = weight_bytes_bf16 * (0.5 if mode.endswith("int8") else 1.0)
+        kv = kv_bytes_int8 if mode.startswith("kv8") else kv_bytes
+        roof = b * V5E_HBM_BW / (w + b * kv)
+        variants[f"b{b}_{mode}"] = {
+            "tokens_per_sec": round(n_tok / dt, 1),
+            "ms_per_token_per_seq": round(dt / n_tok * b * 1e3, 3),
+            "roofline_tokens_per_sec": round(roof, 1),
+        }
     # headline: the best B=8 serving variant.  Measured on v5e at 1.2B the
     # KV-cache read (2.4 GB/step at B=8, full-MHA S=2304) matches the
-    # weight read (2.3 GB bf16), so int8 weights shave only ~25% of step
-    # bytes while paying Pallas per-op overhead — bf16 wins at B=8 and
-    # int8 wins at B=1 (weights dominate there).  Both are reported; the
-    # winner is picked at runtime, not assumed.
+    # weight read (2.3 GB bf16) — which is why round 3 adds the int8 KV
+    # cache (kv8* variants) on top of the round-2 weight quantization.
+    # Every variant is reported; the winner is picked at runtime, not
+    # assumed.
     head_key = max(
-        ("b8_bf16", "b8_int8"),
+        (k for k in variants if k.startswith("b8_")),
         key=lambda k: variants[k]["tokens_per_sec"],
     )
     head = variants[head_key]

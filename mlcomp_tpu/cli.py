@@ -28,7 +28,10 @@ def _cmd_validate(args: argparse.Namespace) -> int:
 def _cmd_dag(args: argparse.Namespace) -> int:
     from mlcomp_tpu.scheduler.local import run_dag_local
 
-    results = run_dag_local(args.config, workers=args.workers)
+    results = run_dag_local(
+        args.config, workers=args.workers, db_path=args.db,
+        workdir=args.workdir,
+    )
     bad = {n: s.value for n, s in results.items() if s.value != "success"}
     print(json.dumps({n: s.value for n, s in results.items()}, indent=2))
     return 1 if bad else 0
@@ -216,6 +219,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # top-level ``model:`` anchor (the common case: point at the same
     # file you trained from)
     model_cfg = doc.get("model", doc) if isinstance(doc, dict) else doc
+    if args.kv_quant:
+        model_cfg = {**model_cfg, "kv_quant": True}
     if not args.ckpt and not args.storage_task:
         # serving random init silently would look healthy and emit junk
         print("error: pass --ckpt or --storage-task (a checkpoint to"
@@ -266,6 +271,12 @@ def main(argv=None) -> int:
     d = sub.add_parser("dag", help="run a DAG locally (in-process scheduler)")
     d.add_argument("config")
     d.add_argument("--workers", type=int, default=1)
+    d.add_argument(
+        "--db", default=None,
+        help="persist the run's store here (default: a temp dir) so"
+        " `status` and the report server can read it afterwards",
+    )
+    d.add_argument("--workdir", default=".")
     d.set_defaults(fn=_cmd_dag)
 
     sb = sub.add_parser("submit", help="submit a DAG to the queue (daemons run it)")
@@ -407,6 +418,11 @@ def main(argv=None) -> int:
         "--quantize", default=None, choices=("int8", "kernel"),
         help="int8 weight-only: storage ('int8', entry dequant) or the"
         " Pallas kernel path ('kernel', best at B=1)",
+    )
+    sv.add_argument(
+        "--kv-quant", action="store_true",
+        help="int8 KV cache (Pallas flash-decode): halves the dominant"
+        " HBM stream of batched/long-context decode",
     )
     sv.add_argument("--warmup", action="store_true",
                     help="precompile the hot buckets before listening")

@@ -186,6 +186,7 @@ class MoELayer(nn.Module):
     capacity_factor: float
     dtype: jnp.dtype
     seq_parallel: "bool | str" = False
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(
@@ -196,7 +197,8 @@ class MoELayer(nn.Module):
 
         x = SelfAttention(
             self.hidden, self.heads, self.kv_heads, self.dtype,
-            seq_parallel=self.seq_parallel, name="attn",
+            seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
+            name="attn",
         )(x, positions, decode=decode, kv_mask=kv_mask)
         h = RMSNorm(self.dtype)(x)
         return x + MoEBlock(
@@ -226,6 +228,8 @@ class MoELM(nn.Module):
     moe_every: int = 2
     dtype: str = "bfloat16"
     seq_parallel: "bool | str" = False
+    # int8 KV cache for decode (transformer.SelfAttention.kv_quant)
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(
@@ -253,12 +257,12 @@ class MoELM(nn.Module):
                 h = MoELayer(
                     self.hidden, self.heads, kv_heads, self.n_experts, d_ff,
                     self.k, self.capacity_factor, dtype,
-                    seq_parallel=self.seq_parallel,
+                    seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
                 )(h, positions, train=train, decode=decode, kv_mask=kv_mask)
             else:
                 h = DecoderLayer(
                     self.hidden, self.heads, kv_heads, d_ff, dtype,
-                    seq_parallel=self.seq_parallel,
+                    seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
                 )(h, positions, decode=decode, kv_mask=kv_mask)
         h = RMSNorm(dtype)(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,

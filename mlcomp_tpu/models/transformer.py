@@ -86,6 +86,11 @@ class SelfAttention(nn.Module):
     # True/"ring" = ring attention (sp unbounded, O(S/n) resident);
     # "ulysses" = all-to-all head exchange (sp ≤ kv_heads, denser kernels)
     seq_parallel: "bool | str" = False
+    # decode-time int8 KV cache (per-(slot, head) absmax): halves the
+    # dominant HBM stream of batched decode; attention runs the Pallas
+    # flash-decode kernel (ops/pallas/decode_attention.py).  Training and
+    # prefill math are untouched — only the cache storage + its readers.
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(self, x, positions, decode=False, kv_mask=None):
@@ -150,6 +155,8 @@ class SelfAttention(nn.Module):
         ``kv_mask`` (B, max_len) marks cache slots that are valid keys
         (False = left-padding in a ragged prompt batch).
         """
+        if self.kv_quant:
+            return self._decode_attention_quant(q, k, v, kv_mask)
         b, s, _, _ = q.shape
         cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
@@ -181,6 +188,122 @@ class SelfAttention(nn.Module):
             )
         return dot_product_attention(q, k_all, v_all, mask=mask)
 
+    def _decode_attention_quant(self, q, k, v, kv_mask):
+        """int8 KV-cache decode (``kv_quant=True``).
+
+        Cache layout is (B, Hkv, L, dh) int8 + (B, Hkv, L) f32 scales —
+        KV-major so the flash-decode kernel walks contiguous tiles; L is
+        lane-rounded at allocation (extra slots sit beyond ``kv_stop``,
+        masked for free) and dh zero-pads to a lane multiple (pads add 0
+        to every logit and produce discarded output columns).
+
+        Single-token steps run ops/pallas/decode_attention.py with
+        per-row [kv_start, i+1) windows (LEFT-pad contract from
+        models/generation.py: invalid slots are a prefix, so
+        ``kv_start = argmax(kv_mask)`` is exact).  Prefill attends the
+        fresh bf16 K/V directly — ragged batches stay on the flash
+        kernel via ``kv_start`` windows instead of dropping to a dense
+        mask like the bf16 cache path.  Chunked prefill (i > 0, s > 1)
+        dequantizes the buffer in XLA — correct, one-off, and unused by
+        the stock generation loop.
+        """
+        from mlcomp_tpu.ops.pallas.decode_attention import (
+            decode_attention,
+            quantize_kv,
+        )
+
+        b, s, hkv, dh = k.shape
+        dhp = -(-dh // 128) * 128
+        # at init time s == the full buffer length (init_cache contract)
+        lpad = -(-s // 128) * 128
+
+        def zeros(shape, dt):
+            return lambda: jnp.zeros(shape, dt)
+
+        ckq = self.variable(
+            "cache", "cached_key_q", zeros((b, hkv, lpad, dhp), jnp.int8)
+        )
+        cks = self.variable(
+            "cache", "cached_key_scale", zeros((b, hkv, 1, lpad), jnp.float32)
+        )
+        cvq = self.variable(
+            "cache", "cached_value_q", zeros((b, hkv, lpad, dhp), jnp.int8)
+        )
+        cvs = self.variable(
+            "cache", "cached_value_scale", zeros((b, hkv, 1, lpad), jnp.float32)
+        )
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = index.value
+        l_buf = ckq.value.shape[2]
+
+        if dhp != dh:
+            kp = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+            vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+        else:
+            kp, vp = k, v
+        kq, ks_ = quantize_kv(kp)
+        vq, vs_ = quantize_kv(vp)
+        ckq.value = jax.lax.dynamic_update_slice(
+            ckq.value, kq.transpose(0, 2, 1, 3), (0, 0, i, 0)
+        )
+        cks.value = jax.lax.dynamic_update_slice(
+            cks.value, ks_.transpose(0, 2, 1)[:, :, None], (0, 0, 0, i)
+        )
+        cvq.value = jax.lax.dynamic_update_slice(
+            cvq.value, vq.transpose(0, 2, 1, 3), (0, 0, i, 0)
+        )
+        cvs.value = jax.lax.dynamic_update_slice(
+            cvs.value, vs_.transpose(0, 2, 1)[:, :, None], (0, 0, 0, i)
+        )
+        index.value = i + s
+
+        if kv_mask is not None:
+            start = jnp.argmax(kv_mask.astype(jnp.int32), axis=1).astype(
+                jnp.int32
+            )
+        else:
+            start = jnp.zeros((b,), jnp.int32)
+
+        if s == 1:
+            qp = (
+                jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+                if dhp != dh else q
+            )
+            out = decode_attention(
+                qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
+                kv_start=start, kv_stop=i + 1,
+                # softmax scale from the TRUE head dim (q/k were zero-
+                # padded to a lane multiple above)
+                scale=1.0 / (dh**0.5),
+            )
+            return out[..., :dh][:, None]
+
+        def fresh_prefill():
+            if kv_mask is None:
+                return dot_product_attention(q, k, v, causal=True)
+            return dot_product_attention(q, k, v, causal=True, kv_start=start)
+
+        def chunked():
+            k_scale = cks.value.transpose(0, 1, 3, 2)       # (B, Hkv, L, 1)
+            v_scale = cvs.value.transpose(0, 1, 3, 2)
+            k_all = (
+                ckq.value.astype(jnp.float32) * k_scale
+            ).astype(k.dtype).transpose(0, 2, 1, 3)[..., :dh]
+            v_all = (
+                cvq.value.astype(jnp.float32) * v_scale
+            ).astype(v.dtype).transpose(0, 2, 1, 3)[..., :dh]
+            slots = jnp.arange(l_buf, dtype=jnp.int32)
+            q_slots = i + jnp.arange(s, dtype=jnp.int32)
+            mask = (slots[None, :] <= q_slots[:, None])[None, None]
+            valid = (slots[None, :] >= start[:, None])[:, None, None, :]
+            return dot_product_attention(
+                q, k_all, v_all, mask=mask & valid
+            )
+
+        return jax.lax.cond(i == 0, fresh_prefill, chunked)
+
 
 class DecoderLayer(nn.Module):
     hidden: int
@@ -189,12 +312,14 @@ class DecoderLayer(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype
     seq_parallel: "bool | str" = False
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(self, x, positions, decode=False, kv_mask=None):
         x = SelfAttention(
             self.hidden, self.heads, self.kv_heads, self.dtype,
-            seq_parallel=self.seq_parallel, name="attn",
+            seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
+            name="attn",
         )(x, positions, decode=decode, kv_mask=kv_mask)
         h = RMSNorm(self.dtype)(x)
         gate = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype, name="gate")(h)
@@ -271,6 +396,11 @@ class TransformerLM(nn.Module):
     # rate under --xla_allow_excess_precision); kept as a knob for
     # platforms where fp32 matmul really is slower
     head_dtype: str = "float32"
+    # int8 KV cache for decode (see SelfAttention.kv_quant): halves the
+    # KV HBM stream that dominates batched/long-context serving.
+    # Config: ``kv_quant: true`` in the model mapping (or ``--kv-quant``
+    # on the serve CLI); training ignores it.
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(
@@ -303,7 +433,8 @@ class TransformerLM(nn.Module):
             # breaking checkpoint interchange between the two modes)
             h = layer_cls(
                 self.hidden, self.heads, kv_heads, mlp_dim, dtype,
-                seq_parallel=self.seq_parallel, name=f"DecoderLayer_{i}",
+                seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
+                name=f"DecoderLayer_{i}",
             )(h, positions, decode, kv_mask)
         h = RMSNorm(dtype)(h)
         head = _LMHead(
