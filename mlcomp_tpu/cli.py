@@ -190,6 +190,90 @@ def _cmd_pool(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_tokenize(args: argparse.Namespace) -> int:
+    """Text corpus -> flat token .bin (+ .json sidecar) for ``token_bin``.
+
+    Default encoding is BYTE-level (ids 0-255 + EOS 256 between
+    documents): dependency-free, lossless on any UTF-8 text, and the
+    standard small-scale baseline.  ``--hf-tokenizer PATH`` swaps in a
+    local pretrained tokenizer directory via ``transformers`` (LOCAL
+    path only — this environment has no network egress, and serving
+    real vocabularies is the production path anyway).
+    """
+    from pathlib import Path
+
+    import numpy as np
+
+    out = Path(args.output)
+    sidecar = out.with_suffix(out.suffix + ".json")
+
+    def _keep(q: Path) -> bool:
+        # never re-ingest our own output (a second run over the same
+        # directory would tokenize the .bin garbage into the corpus),
+        # and skip hidden trees (.git and friends)
+        if q.resolve() in (out.resolve(), sidecar.resolve()):
+            return False
+        return not any(part.startswith(".") for part in q.parts)
+
+    paths: list = []
+    for src in args.inputs:
+        p = Path(src)
+        if p.is_dir():
+            paths.extend(
+                sorted(q for q in p.rglob("*") if q.is_file() and _keep(q))
+            )
+        elif p.exists():
+            if _keep(p):
+                paths.append(p)
+        else:
+            print(f"error: no such input {src!r}", file=sys.stderr)
+            return 2
+    if not paths:
+        print("error: no input files", file=sys.stderr)
+        return 2
+
+    tok = None
+    if args.hf_tokenizer:
+        from transformers import AutoTokenizer  # local files only
+
+        tok = AutoTokenizer.from_pretrained(
+            args.hf_tokenizer, local_files_only=True
+        )
+        eos_id = tok.eos_token_id
+        if eos_id is None:
+            # first id past BOTH the base vocab and any added tokens —
+            # tok.vocab_size excludes added ids and could alias one
+            eos_id = len(tok)
+        vocab_size = max(len(tok), eos_id + 1)
+    else:
+        eos_id = 256
+        vocab_size = 257
+    dtype = np.uint16 if vocab_size <= 65536 else np.uint32
+
+    total = 0
+    with open(out, "wb") as f:
+        for p in paths:
+            text = p.read_text(encoding="utf-8", errors="replace")
+            if tok is not None:
+                ids = tok.encode(text, add_special_tokens=False)
+            else:
+                ids = list(text.encode("utf-8"))
+            ids.append(eos_id)
+            np.asarray(ids, dtype=dtype).tofile(f)
+            total += len(ids)
+    meta = {
+        "dtype": np.dtype(dtype).name,
+        "vocab_size": int(vocab_size),
+        "eos_id": int(eos_id),
+        "tokens": int(total),
+        "documents": len(paths),
+        "tokenizer": args.hf_tokenizer or "byte",
+    }
+    sidecar.write_text(json.dumps(meta))
+    print(json.dumps(meta))
+    return 0
+
+
 def _cmd_report(args: argparse.Namespace) -> int:
     from mlcomp_tpu.report.server import serve
 
@@ -369,6 +453,20 @@ def main(argv=None) -> int:
     pl.add_argument("--heartbeat-timeout", type=float, default=30.0)
     pl.add_argument("--poll", type=float, default=2.0)
     pl.set_defaults(fn=_cmd_pool)
+
+    tk = sub.add_parser(
+        "tokenize",
+        help="text corpus -> token .bin for the token_bin dataset"
+        " (byte-level default; --hf-tokenizer for a local vocab)",
+    )
+    tk.add_argument("inputs", nargs="+", help="text files or directories")
+    tk.add_argument("-o", "--output", required=True, help="output .bin path")
+    tk.add_argument(
+        "--hf-tokenizer", default=None,
+        help="LOCAL pretrained tokenizer directory (transformers);"
+        " default is byte-level (vocab 257, EOS 256)",
+    )
+    tk.set_defaults(fn=_cmd_tokenize)
 
     r = sub.add_parser("report", help="run the report/UI HTTP server")
     r.add_argument("--db", default="mlcomp.sqlite")

@@ -176,6 +176,53 @@ def image_folder(
     }
 
 
+@DATASETS.register("token_bin")
+def token_bin(
+    path: str,
+    seq_len: int,
+    dtype: Optional[str] = None,
+    limit: int = 0,
+    **_,
+) -> Dict[str, np.ndarray]:
+    """Memory-mapped flat token stream -> (N, seq_len) LM training rows.
+
+    The LM-pretraining data path (``cli tokenize`` writes the .bin): a
+    single contiguous stream of token ids (documents separated by the
+    tokenizer's EOS), chunked into non-overlapping ``seq_len`` rows.
+    The array stays an ``np.memmap`` — the loader's gather reads touch
+    only the pages of the current batch, so corpora far larger than
+    host RAM train fine (the torch-DataLoader-worker analog is the OS
+    page cache doing the reading).  ``lm_cross_entropy`` shifts inputs
+    internally, so rows need no label column.
+
+    ``dtype`` defaults from the ``<path>.json`` sidecar ``cli
+    tokenize`` writes (falling back to uint16); ``limit`` (rows,
+    0 = all) bounds smoke runs.
+    """
+    import json
+
+    p = Path(path)
+    meta_path = p.with_suffix(p.suffix + ".json")
+    meta: Dict[str, Any] = {}
+    if meta_path.exists():
+        meta = json.loads(meta_path.read_text())
+    dt = np.dtype(dtype or meta.get("dtype", "uint16"))
+    stream = np.memmap(p, dtype=dt, mode="r")
+    n = len(stream) // seq_len
+    if n == 0:
+        raise ValueError(
+            f"token_bin: {path} holds {len(stream)} tokens < seq_len "
+            f"{seq_len}"
+        )
+    if limit:
+        n = min(n, limit)
+    x = stream[: n * seq_len].reshape(n, seq_len)
+    out: Dict[str, Any] = {"x": x}
+    if "vocab_size" in meta:
+        out["_vocab_size"] = int(meta["vocab_size"])
+    return out
+
+
 @DATASETS.register("npz")
 def npz(
     path: str, x_key: str = "x", y_key: Optional[str] = None, **_

@@ -181,7 +181,7 @@ def decode_attention(
     scale = scale if scale is not None else 1.0 / (dh**0.5)
     blk = next(
         (bl for bl in (block_kv, 512, 256, LANES)
-         if bl <= block_kv and l_buf % bl == 0),
+         if bl <= block_kv and bl % LANES == 0 and l_buf % bl == 0),
         None,
     )
     if blk is None:

@@ -1,0 +1,86 @@
+"""cli tokenize -> token_bin -> LM training: the text-corpus data path."""
+
+import json
+
+import numpy as np
+
+from mlcomp_tpu.cli import main
+from mlcomp_tpu.data.datasets import create_dataset
+from mlcomp_tpu.data.loader import DataLoader
+
+
+def _write_corpus(tmp_path):
+    (tmp_path / "a.txt").write_text("hello tpu world\n" * 40)
+    (tmp_path / "b.txt").write_text("a second document of text\n" * 40)
+    return tmp_path
+
+
+def test_tokenize_byte_roundtrip(tmp_path, capsys):
+    corpus = _write_corpus(tmp_path)
+    out = tmp_path / "c.bin"
+    assert main(["tokenize", str(corpus), "-o", str(out)]) == 0
+    meta = json.loads((tmp_path / "c.bin.json").read_text())
+    assert meta["vocab_size"] == 257 and meta["eos_id"] == 256
+    stream = np.memmap(out, dtype=np.uint16, mode="r")
+    assert len(stream) == meta["tokens"]
+    # documents are EOS-separated; bytes decode losslessly
+    text = bytes(int(t) for t in stream if t < 256).decode()
+    assert "hello tpu world" in text and "second document" in text
+    assert int((stream == 256).sum()) == meta["documents"]
+
+
+def test_token_bin_dataset_is_memmapped(tmp_path):
+    out = tmp_path / "c.bin"
+    main(["tokenize", str(_write_corpus(tmp_path)), "-o", str(out)])
+    d = create_dataset({"name": "token_bin", "path": str(out), "seq_len": 32})
+    assert isinstance(d["x"], np.memmap)  # pages read lazily by gathers
+    assert d["x"].shape[1] == 32
+    assert d["_vocab_size"] == 257
+    dl = DataLoader(d, batch_size=4, shuffle=True)
+    batch = next(iter(dl))
+    assert batch["x"].shape == (4, 32)
+    assert not isinstance(batch["x"], np.memmap)  # gathered copies
+
+    limited = create_dataset(
+        {"name": "token_bin", "path": str(out), "seq_len": 32, "limit": 2}
+    )
+    assert limited["x"].shape[0] == 2
+
+
+def test_token_bin_trains_lm(tmp_path):
+    out = tmp_path / "c.bin"
+    main(["tokenize", str(_write_corpus(tmp_path)), "-o", str(out)])
+    from mlcomp_tpu.scheduler.local import run_dag_local
+
+    dag = {
+        "info": {"name": "textlm", "project": "t"},
+        "executors": {
+            "train": {
+                "type": "train",
+                "stage": "train",
+                "args": {
+                    "model": {
+                        "name": "transformer_lm", "vocab_size": 257,
+                        "hidden": 32, "layers": 1, "heads": 2,
+                    },
+                    "optimizer": {"name": "adam", "lr": 1e-3},
+                    "loss": "lm_cross_entropy",
+                    "metrics": [],
+                    "epochs": 1,
+                    "data": {
+                        "train": {
+                            "name": "token_bin", "path": str(out),
+                            "seq_len": 32, "batch_size": 8,
+                        }
+                    },
+                    "project": "t", "dag_name": "textlm",
+                    "storage_root": str(tmp_path / "storage"),
+                },
+            }
+        },
+    }
+    results = run_dag_local(
+        dag, workers=1, db_path=str(tmp_path / "db.sqlite"),
+        workdir=str(tmp_path),
+    )
+    assert {s.value for s in results.values()} == {"success"}
