@@ -207,23 +207,29 @@ def _cmd_tokenize(args: argparse.Namespace) -> int:
     out = Path(args.output)
     sidecar = out.with_suffix(out.suffix + ".json")
 
-    def _keep(q: Path) -> bool:
+    def _keep(q: Path, root: Path) -> bool:
         # never re-ingest our own output (a second run over the same
-        # directory would tokenize the .bin garbage into the corpus),
-        # and skip hidden trees (.git and friends)
+        # directory would tokenize the .bin garbage into the corpus);
+        # inside a scanned directory, skip hidden trees (.git and
+        # friends) — judged only BELOW the user-given root, so roots
+        # like ../corpus or ~/.cache/corpus still work when named
+        # explicitly
         if q.resolve() in (out.resolve(), sidecar.resolve()):
             return False
-        return not any(part.startswith(".") for part in q.parts)
+        rel = q.relative_to(root).parts if root is not None else ()
+        return not any(part.startswith(".") for part in rel)
 
     paths: list = []
     for src in args.inputs:
         p = Path(src)
         if p.is_dir():
             paths.extend(
-                sorted(q for q in p.rglob("*") if q.is_file() and _keep(q))
+                sorted(
+                    q for q in p.rglob("*") if q.is_file() and _keep(q, p)
+                )
             )
         elif p.exists():
-            if _keep(p):
+            if _keep(p, None):
                 paths.append(p)
         else:
             print(f"error: no such input {src!r}", file=sys.stderr)

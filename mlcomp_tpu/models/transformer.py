@@ -175,15 +175,28 @@ class SelfAttention(nn.Module):
         mask = (slots[None, :] <= q_slots[:, None])[None, None]  # (1,1,S,max)
         if kv_mask is not None:
             mask = mask & kv_mask[:, None, None, :].astype(jnp.bool_)
-        if s > 1 and kv_mask is None:
+        if s > 1:
             # prefill fast path: when the cache is still empty, attention
             # over the full buffer under the slot mask equals plain causal
             # attention over just the new K/V — which takes the flash
-            # kernel (dense masks don't).  lax.cond keeps chunked prefill
-            # (i > 0) on the general path.
+            # kernel (dense masks don't).  Ragged LEFT-padded batches
+            # stay on the kernel too: the pad prefix becomes a per-row
+            # kv_start window (pad QUERY rows get garbage outputs that
+            # generation discards — their real attention output is
+            # never read).  lax.cond keeps chunked prefill (i > 0) on
+            # the general path.
+            if kv_mask is None:
+                fresh = lambda: dot_product_attention(q, k, v, causal=True)
+            else:
+                start = jnp.argmax(
+                    kv_mask[:, :s].astype(jnp.int32), axis=1
+                ).astype(jnp.int32)
+                fresh = lambda: dot_product_attention(
+                    q, k, v, causal=True, kv_start=start
+                )
             return jax.lax.cond(
                 i == 0,
-                lambda: dot_product_attention(q, k, v, causal=True),
+                fresh,
                 lambda: dot_product_attention(q, k_all, v_all, mask=mask),
             )
         return dot_product_attention(q, k_all, v_all, mask=mask)
