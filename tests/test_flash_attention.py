@@ -265,6 +265,49 @@ def test_bounded_scheduled_matches_rectangular(monkeypatch):
     )
 
 
+def test_ragged_causal_scheduled_matches_rectangular(monkeypatch):
+    """r3 late: causal + per-row windows (left-padded decode prefill) on
+    the compressed dynamic grid must equal the rectangular causal path
+    bit-for-bit — fwd and all three grads, GQA, including a row whose
+    window∩causal intersection is empty for early q blocks."""
+    from mlcomp_tpu.ops.pallas import flash_attention as fa
+
+    b, s = 4, 512
+    q = _rand((b, s, 4, 64), 40)
+    k = _rand((b, s, 2, 64), 41)
+    v = _rand((b, s, 2, 64), 42)
+    w = _rand((b, s, 4, 64), 43)
+    # lo = left-pad prefix; row 3's window starts past the first THREE
+    # q blocks' causal reach (rows < 384 see no valid key at all)
+    lo = jnp.asarray([0, 64, 200, 384], jnp.int32)
+    hi = jnp.full((b,), s, jnp.int32)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa.flash_attention(q, k, v, causal=True, kv_start=lo,
+                               kv_stop=hi, block_q=128, block_kv=128) * w
+        )
+
+    def run():
+        out = fa.flash_attention(q, k, v, causal=True, kv_start=lo,
+                                 kv_stop=hi, block_q=128, block_kv=128)
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return out, g
+
+    monkeypatch.setenv("MLCOMP_FLASH_BOUNDED_SCHED_CAUSAL", "0")
+    out_rect, g_rect = run()
+    monkeypatch.setenv("MLCOMP_FLASH_BOUNDED_SCHED_CAUSAL", "1")
+    out_sched, g_sched = run()
+    np.testing.assert_array_equal(np.asarray(out_rect), np.asarray(out_sched))
+    for a, b_ in zip(g_rect, g_sched):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    # rows before their window start see no keys: exact zeros
+    np.testing.assert_array_equal(
+        np.asarray(out_sched[3, :384]),
+        np.zeros_like(np.asarray(out_sched[3, :384])),
+    )
+
+
 def test_kv_stop_only_right_padding():
     """kv_stop alone (BERT-style right padding) via the dispatch layer."""
     from mlcomp_tpu.ops.attention import dot_product_attention
