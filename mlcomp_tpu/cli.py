@@ -324,9 +324,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                   f" {args.storage_task!r}", file=sys.stderr)
             return 2
         ckpt = resolve_storage_ckpt(*parts)
+    mesh_cfg = None
+    if args.mesh:
+        try:
+            mesh_cfg = {
+                k.strip(): int(v)
+                for k, v in (kv.split("=") for kv in args.mesh.split(","))
+            }
+        except ValueError:
+            print(f"error: --mesh expects AXIS=N[,AXIS=N...], got"
+                  f" {args.mesh!r}", file=sys.stderr)
+            return 2
     service = load_service(
         model_cfg,
         ckpt_dir=ckpt,
+        mesh_cfg=mesh_cfg,
         batch_sizes=tuple(int(x) for x in args.batch_sizes.split(",")),
         prompt_buckets=tuple(int(x) for x in args.prompt_buckets.split(",")),
         max_new_buckets=tuple(
@@ -522,6 +534,16 @@ def main(argv=None) -> int:
         "--quantize", default=None, choices=("int8", "kernel"),
         help="int8 weight-only: storage ('int8', entry dequant) or the"
         " Pallas kernel path ('kernel', best at B=1)",
+    )
+    sv.add_argument(
+        "--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
+        help="serve SHARDED over a device mesh: Megatron tp weight"
+        " layout, SPMD decode — for models too big for one chip."
+        " Devices not claimed by named axes fold into dp (e.g."
+        " 'tp=4' on 8 chips gives dp=2 tp=4), and every --batch-sizes"
+        " entry must divide dp*fsdp — pass 'dp=1,tp=8' to keep small"
+        " batches servable.  Pallas paths (--quantize kernel,"
+        " --kv-quant) are single-chip-only",
     )
     sv.add_argument(
         "--kv-quant", action="store_true",

@@ -82,12 +82,41 @@ class GenerationService:
         pad_id: int = 0,
         quantize: "bool | str" = False,
         seed: int = 0,
+        mesh=None,
     ):
         import jax
 
         from mlcomp_tpu.ops.quant import quantize_params
 
         self.model = model
+        # multi-chip serving: a jax.sharding.Mesh (from load_service's
+        # mesh config).  Weights arrive already sharded; prompts get the
+        # mesh's batch sharding; the KV cache shards by XLA propagation
+        # from the tp-sharded K/V projections.  The Pallas paths
+        # (quantize="kernel", model kv_quant) are single-chip-only: the
+        # kernels would need shard_map wrapping — refused below rather
+        # than silently degrading.
+        self.mesh = mesh
+        if mesh is not None:
+            dbatch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            bad = [b for b in batch_sizes if b % dbatch]
+            if bad:
+                raise ValueError(
+                    f"batch sizes {bad} don't divide the mesh's data axes "
+                    f"(dp*fsdp = {dbatch}); fix --batch-sizes"
+                )
+            if getattr(model, "kv_quant", False):
+                raise ValueError(
+                    "kv_quant (int8 KV cache) is single-chip for now: the "
+                    "Pallas flash-decode kernel needs shard_map under a "
+                    "mesh; drop --kv-quant or the mesh"
+                )
+            if str(quantize).strip().lower() == "kernel":
+                raise ValueError(
+                    "quantize='kernel' is single-chip for now (Pallas "
+                    "under SPMD needs shard_map); use 'int8' (entry "
+                    "dequant) or bf16 with a mesh"
+                )
         self.batch_sizes = tuple(sorted(batch_sizes))
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.max_new_buckets = tuple(sorted(max_new_buckets))
@@ -160,10 +189,19 @@ class GenerationService:
 
         n = 0
         s = self.prompt_buckets[-1]
+        # smallest + largest SERVABLE batch (1 may not be a bucket
+        # under a mesh); inputs must carry the same sharding requests
+        # will — input sharding is part of the jit cache key
         for nb in self.max_new_buckets:
-            for b in {1, self.batch_sizes[-1]}:
+            for b in {self.batch_sizes[0], self.batch_sizes[-1]}:
                 prompts = jnp.ones((b, s), jnp.int32)
                 mask = jnp.ones((b, s), bool)
+                if self.mesh is not None:
+                    from mlcomp_tpu.parallel.mesh import batch_sharding
+
+                    sh = batch_sharding(self.mesh)
+                    prompts = jax.device_put(prompts, sh)
+                    mask = jax.device_put(mask, sh)
                 self._rng, sub = jax.random.split(self._rng)
                 fn = self._get_fn(b, s, nb)
                 out = fn(self.variables, prompt=prompts, prompt_mask=mask,
@@ -266,10 +304,17 @@ class GenerationService:
 
         self._rng, sub = jax.random.split(self._rng)
         fn = self._get_fn(b_bucket, s_bucket, nb)
+        jprompts, jmask = jnp.asarray(prompts), jnp.asarray(mask)
+        if self.mesh is not None:
+            from mlcomp_tpu.parallel.mesh import batch_sharding
+
+            sh = batch_sharding(self.mesh)
+            jprompts = jax.device_put(jprompts, sh)
+            jmask = jax.device_put(jmask, sh)
         out = np.asarray(fn(
             self.variables,
-            prompt=jnp.asarray(prompts),
-            prompt_mask=jnp.asarray(mask),
+            prompt=jprompts,
+            prompt_mask=jmask,
             rng=sub,
         ))
         latency_ms = (time.perf_counter() - t0) * 1e3
@@ -292,10 +337,20 @@ class GenerationService:
 def load_service(
     model_cfg: Dict[str, Any],
     ckpt_dir: Optional[str] = None,
+    mesh_cfg: Optional[Dict[str, int]] = None,
     **service_kw,
 ) -> GenerationService:
     """Build the model, restore weights (weights-only, like the
-    infer/valid/generate executors), and wrap in a GenerationService."""
+    infer/valid/generate executors), and wrap in a GenerationService.
+
+    ``mesh_cfg`` (e.g. ``{"tp": 4}``) serves the model SHARDED over a
+    device mesh — the path for models too big for one chip: weights get
+    the same Megatron tp layout training uses (`parallel/sharding.py`
+    rules), the KV cache shards by propagation, and each request batch
+    runs as one SPMD program (certified by the driver's dp×tp decode
+    dryrun leg).  Restore is host-then-shard, which bounds the model at
+    host RAM — fine for single-host slices; multi-host serving would
+    restore shard-wise through orbax instead."""
     import jax
     import jax.numpy as jnp
 
@@ -305,18 +360,36 @@ def load_service(
 
     model = create_model(dict(model_cfg))
     example = jnp.zeros((1, 8), jnp.int32)
-    params, mstate = init_model(model, {"x": example}, jax.random.PRNGKey(0))
     # a throwaway optimizer only shapes the TrainState container;
     # restore_eval_state is weights-only and never reads opt_state
-    state = TrainState.create(
-        model.apply, params, create_optimizer({"name": "sgd", "lr": 0.0}),
-        mstate,
-    )
+    opt = create_optimizer({"name": "sgd", "lr": 0.0})
+
+    def init_fn():
+        params, mstate = init_model(
+            model, {"x": example}, jax.random.PRNGKey(0)
+        )
+        return TrainState.create(model.apply, params, opt, mstate)
+
+    mesh = None
+    if mesh_cfg:
+        from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh
+        from mlcomp_tpu.parallel.sharding import make_sharded_state
+
+        mesh = make_mesh(MeshSpec.from_config(mesh_cfg))
+        # sharded from the first byte: init lands directly on the
+        # training layout (same spec_for rules), and restore_eval_state
+        # places restored arrays onto those shardings — the full model
+        # never materializes on one device
+        state, _ = make_sharded_state(init_fn, mesh)
+    else:
+        state = init_fn()
     if ckpt_dir:
         from mlcomp_tpu.io.checkpoint import restore_eval_state
 
         state = restore_eval_state(ckpt_dir, state)
-    return GenerationService(model, state.eval_variables, **service_kw)
+    return GenerationService(
+        model, state.eval_variables, mesh=mesh, **service_kw
+    )
 
 
 def resolve_storage_ckpt(project: str, dag_name: str, task: str) -> str:

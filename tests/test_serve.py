@@ -205,3 +205,53 @@ def test_serve_http_round_trip(tmp_path, monkeypatch):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(bad)
     assert ei.value.code == 400
+
+
+def test_serve_sharded_mesh_matches_unsharded():
+    """tp-sharded serving (load_service mesh_cfg) must produce the same
+    greedy tokens as the single-device service — the SPMD program is a
+    layout change, not a math change."""
+    from mlcomp_tpu.serve import load_service
+
+    cfg = {"name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+           "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32"}
+    kw = dict(batch_sizes=(4,), prompt_buckets=(8,), max_new_buckets=(4,))
+    plain = load_service(cfg, **kw)
+    sharded = load_service(cfg, mesh_cfg={"dp": 4, "tp": 2}, **kw)
+    try:
+        assert sharded.mesh is not None
+        q = sharded.variables["params"]["DecoderLayer_0"]["attn"]["q"][
+            "kernel"
+        ]
+        assert "tp" in q.sharding.spec, q.sharding.spec
+        prompt = [3, 14, 15, 9, 2]
+        got = sharded.generate(prompt, max_new_tokens=4)
+        want = plain.generate(prompt, max_new_tokens=4)
+        assert got["ids"] == want["ids"], (got, want)
+    finally:
+        plain.close()
+        sharded.close()
+
+
+def test_serve_mesh_refuses_pallas_paths_and_bad_batches():
+    from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec.from_config({"dp": 2, "tp": 4}))
+    model = _tiny_model()
+    prompt = jnp.asarray(np.random.RandomState(0).randint(1, 64, (1, 8)))
+    params, mstate = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    variables = {"params": params, **mstate}
+    with pytest.raises(ValueError, match="don't divide"):
+        GenerationService(model, variables, mesh=mesh, batch_sizes=(1, 2))
+    with pytest.raises(ValueError, match="single-chip"):
+        GenerationService(
+            model, variables, mesh=mesh, batch_sizes=(2,),
+            quantize="kernel",
+        )
+    kv_model = create_model({
+        "name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+        "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32",
+        "kv_quant": True,
+    })
+    with pytest.raises(ValueError, match="single-chip"):
+        GenerationService(kv_model, variables, mesh=mesh, batch_sizes=(2,))
