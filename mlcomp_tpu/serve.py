@@ -348,9 +348,9 @@ def load_service(
     the same Megatron tp layout training uses (`parallel/sharding.py`
     rules), the KV cache shards by propagation, and each request batch
     runs as one SPMD program (certified by the driver's dp×tp decode
-    dryrun leg).  Restore is host-then-shard, which bounds the model at
-    host RAM — fine for single-host slices; multi-host serving would
-    restore shard-wise through orbax instead."""
+    dryrun leg).  Init runs under jit with sharded outputs and orbax
+    restores directly onto those shardings (io/checkpoint.py), so the
+    full model materializes on no single device or host."""
     import jax
     import jax.numpy as jnp
 
@@ -376,6 +376,13 @@ def load_service(
         from mlcomp_tpu.parallel.sharding import make_sharded_state
 
         mesh = make_mesh(MeshSpec.from_config(mesh_cfg))
+        # install process-wide like the Trainer does: model forward code
+        # reads current_mesh() for shard_map-based paths (ring/sp, the
+        # pipelined LM's pp stages) — without this they'd silently trace
+        # mesh-less and waste those axes
+        from mlcomp_tpu.parallel.mesh import set_current_mesh
+
+        set_current_mesh(mesh)
         # sharded from the first byte: init lands directly on the
         # training layout (same spec_for rules), and restore_eval_state
         # places restored arrays onto those shardings — the full model

@@ -39,6 +39,7 @@ def make_train_step(
     metric_fns: Dict[str, Callable],
     rng_key: Optional[jax.Array] = None,
     grad_accum: int = 1,
+    augment_fn=None,
 ):
     """Build the pure train step; jitted once, reused every step.
 
@@ -63,6 +64,14 @@ def make_train_step(
 
     def train_step(state: TrainState, batch):
         step_rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
+        if augment_fn is not None:
+            # on-device augmentation (data/augment.py), train-only, keyed
+            # off the step like dropout; applied before any microbatch
+            # split so grad_accum sees the same pixels a fused batch would
+            aug_key = jax.random.fold_in(
+                jax.random.fold_in(base_key, 0x5EED), state.step
+            )
+            batch = {**batch, "x": augment_fn(aug_key, batch["x"])}
 
         def grads_of(params, model_state, batch, step_rngs):
             def loss_of(params):
@@ -249,12 +258,15 @@ class Trainer:
             _create_state, self.mesh
         )
 
+        from mlcomp_tpu.data.augment import build_augment
+
         self._train_step = jax.jit(
             make_train_step(
                 self.loss_fn,
                 self.metric_fns,
                 rng_key=jax.random.PRNGKey(self.seed + 1),
                 grad_accum=int(cfg.get("grad_accum", 1)),
+                augment_fn=build_augment(cfg.get("augment")),
             ),
             donate_argnums=(0,),
         )
