@@ -25,6 +25,11 @@ Ops apply to ``batch["x"]`` (NHWC) only — classification/regression
 recipes.  Segmentation needs label-joint transforms; pair it with
 ``hflip`` disabled or augment offline (the masks would desync).
 Composition order: random_resized_crop | crop -> hflip -> color.
+
+Measured on v5e (marginal fori_loop timing): RRC+hflip on a
+(128, 224, 224, 3) batch costs **1.56 ms/step** — 3.3% of the 46.9 ms
+ResNet-50 train step, vs an entire extra pipeline stage in the
+host-process alternative.
 """
 
 from __future__ import annotations

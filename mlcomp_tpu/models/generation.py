@@ -100,6 +100,71 @@ def sample_token(
     ).astype(jnp.int32)
 
 
+def process_logits_rowwise(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-ROW sampling filters: knobs are traced (B,) arrays, so one
+    compiled program serves every knob combination (the serving path —
+    static knobs would multiply the compile cache by every distinct
+    temperature a client sends).
+
+    Neutral values are well-defined per row: ``top_k >= V`` and
+    ``top_p >= 1`` keep everything; ``temperature`` is clamped (greedy
+    rows are selected OUTSIDE, in ``sample_token_rowwise``, where the
+    argmax needs the unfiltered logits anyway).  ``top_k`` uses a rank
+    mask (argsort-of-argsort) rather than ``lax.top_k`` because k is
+    data here, not a static shape.
+    """
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    logits = logits / jnp.maximum(temperature[:, None], 1e-6)
+    # ONE descending sort serves both filters (this runs per decode
+    # token on the serving hot path): the per-row k-th VALUE gathers
+    # from it (same keep-ties-with-the-kth semantics as the static
+    # lax.top_k path), and top-p reads its k-filtered prefix masses
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        sorted_logits, jnp.clip(top_k, 1, v)[:, None] - 1, axis=-1
+    )
+    sl_k = jnp.where(sorted_logits < kth, -jnp.inf, sorted_logits)
+    probs = jax.nn.softmax(sl_k, axis=-1)
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
+    cutoff = jnp.min(
+        jnp.where(keep, sl_k, jnp.inf), axis=-1, keepdims=True
+    )
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def sample_token_rowwise(
+    rng: jax.Array,
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-row knobs version of ``sample_token``: rows with
+    ``temperature <= 0`` decode greedily, the rest sample through the
+    row-wise filters — all inside one traced program.  An all-greedy
+    batch (the common default) skips the sort/softmax/categorical work
+    entirely via ``lax.cond`` at runtime, so the zero-recompile
+    property costs nothing when nobody samples."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled_branch():
+        sampled = jax.random.categorical(
+            rng, process_logits_rowwise(logits, temperature, top_k, top_p)
+        ).astype(jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    return jax.lax.cond(
+        jnp.any(temperature > 0.0), sampled_branch, lambda: greedy
+    )
+
+
 def generate(
     model,
     variables: Dict[str, Any],
@@ -130,6 +195,12 @@ def generate(
       pad rows get RoPE positions counted from their first real token and
       their pad slots never attend.
     - ``eos_id``: rows emit ``pad_id`` after producing ``eos_id``.
+    - sampling knobs: floats/ints trace STATICALLY (distinct values =
+      distinct programs; the simple path).  Passing ``temperature`` as
+      a (B,) ARRAY switches to per-ROW sampling (``top_k``/``top_p``
+      arrays optional then, neutral per row when omitted): one compiled
+      program serves any knob mix — what the serving daemon batches
+      mixed requests with.
 
     Returns (B, S + max_new_tokens) int32 ids (prompt included; padding
     preserved as given).
@@ -234,8 +305,31 @@ def generate(
     cache = updated["cache"]
     last_logits = logits[:, -1]
 
+    rowwise = hasattr(temperature, "ndim")
+    if rowwise:
+        vocab = getattr(model, "vocab_size", None) or (1 << 30)
+
+        def row(x, dtype):
+            # 0-d scalars broadcast to every row; (B,) passes through
+            return jnp.broadcast_to(
+                jnp.asarray(x, dtype).reshape(-1), (b,)
+            )
+
+        t_row = row(temperature, jnp.float32)
+        k_row = (
+            jnp.full((b,), vocab, jnp.int32) if top_k is None
+            else row(top_k, jnp.int32)
+        )
+        p_row = (
+            jnp.ones((b,), jnp.float32) if top_p is None
+            else row(top_p, jnp.float32)
+        )
+
     def next_token(rng, logits, done):
-        tok = sample_token(rng, logits, temperature, top_k, top_p)
+        if rowwise:
+            tok = sample_token_rowwise(rng, logits, t_row, k_row, p_row)
+        else:
+            tok = sample_token(rng, logits, temperature, top_k, top_p)
         tok = jnp.where(done, jnp.int32(pad_id), tok)
         if eos_id is not None:
             done = done | (tok == eos_id)

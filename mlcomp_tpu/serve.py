@@ -17,10 +17,11 @@ replacing it still needs to SERVE the model they trained.  This daemon
 - **weight residency**: weights load once, optionally int8-quantized
   with the Pallas kernel consuming them directly (``--quantize kernel``,
   the measured B=1 win) or pre-cast to bf16;
-- sampling knobs (temperature/top-k/top-p/eos) are SERVICE-level config:
-  they trace into the compiled programs, so per-request overrides would
-  multiply the compile cache — fix them at startup (the standard
-  fixed-recipe serving trade).
+- **per-request sampling**: temperature/top-k/top-p ride the compiled
+  program as per-ROW traced arrays (generation.py's rowwise path), so a
+  request can override the service defaults at ZERO recompile cost and
+  mixed-knob requests batch together; ``eos_id``/``pad_id`` stay
+  service-level (they are structural).
 
 Checkpoints resolve exactly like the generate executor: an explicit
 ``--ckpt`` directory, or the ModelStorage layout (``--storage-task``)
@@ -28,8 +29,10 @@ the train executor writes.
 
 HTTP surface (stdlib http.server, same conventions as report/server.py):
 
-    POST /generate  {"prompt": [ids...], "max_new_tokens": 64}
+    POST /generate  {"prompt": [ids...], "max_new_tokens": 64,
+                     "temperature": 0.8, "top_k": 50, "top_p": 0.95}
         -> {"ids": [...generated ids only...], "latency_ms": ...}
+        (sampling fields optional; default to the service config)
     GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...}
 
 ``MLCOMP_TPU_SERVE_TOKEN`` (optional) demands ``Authorization: Bearer``
@@ -122,13 +125,21 @@ class GenerationService:
         self.max_new_buckets = tuple(sorted(max_new_buckets))
         self.batch_window_s = batch_window_ms / 1e3
         self.pad_id = int(pad_id)
+        # eos/pad are structural (trace into the program); the sampling
+        # knobs are per-ROW traced arrays (generation.py rowwise path),
+        # so per-request overrides share one compiled program per bucket
         self.knobs: Dict[str, Any] = {
-            "temperature": float(temperature),
-            "top_k": top_k,
-            "top_p": top_p,
             "eos_id": eos_id,
             "pad_id": int(pad_id),
         }
+        self.defaults: Dict[str, Any] = {
+            "temperature": float(temperature),
+            "top_k": top_k,
+            "top_p": top_p,
+        }
+        self._neutral_k = int(
+            getattr(model, "vocab_size", None) or (1 << 30)
+        )
         self.quant_mode = None
         if quantize:
             self.quant_mode = (
@@ -155,28 +166,59 @@ class GenerationService:
 
     # ------------------------------------------------------------- public
 
-    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int) -> Future:
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+    ) -> Future:
         """Enqueue one generation request; resolves to a list of the
         GENERATED ids (prompt excluded, truncated at the request's
-        ``max_new_tokens``; pads after EOS trimmed)."""
+        ``max_new_tokens``; pads after EOS trimmed).
+
+        Per-request sampling knobs default to the service config; they
+        ride the compiled program as per-row arrays, so overriding them
+        costs no recompile and mixed-knob requests batch together."""
         ids = [int(t) for t in prompt_ids]
         if not ids:
             raise ValueError("prompt must be non-empty")
         n_new = int(max_new_tokens)
         if n_new <= 0:
             raise ValueError("max_new_tokens must be positive")
+        t = self.defaults["temperature"] if temperature is None else float(
+            temperature
+        )
+        if not 0.0 <= t <= 100.0:
+            raise ValueError(f"temperature must be in [0, 100], got {t}")
+        k = self.defaults["top_k"] if top_k is None else int(top_k)
+        if k is not None and k < 1:
+            raise ValueError(f"top_k must be >= 1, got {k}")
+        if k is not None:
+            # anything >= vocab is a no-op; clamping here keeps a huge
+            # client value from overflowing the int32 knob row in the
+            # batcher (which would fail the whole co-batched group)
+            k = min(k, self._neutral_k)
+        p = self.defaults["top_p"] if top_p is None else float(top_p)
+        if p is not None and not 0.0 < p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {p}")
         # validate bucket fit NOW (caller thread) so errors surface as
         # request errors, not batcher crashes
         _bucket(len(ids), self.prompt_buckets, "prompt length")
         nb = _bucket(n_new, self.max_new_buckets, "max_new_tokens")
         fut: Future = Future()
-        self._queue.put({"ids": ids, "n_new": n_new, "bucket_new": nb,
-                         "future": fut})
+        self._queue.put({
+            "ids": ids, "n_new": n_new, "bucket_new": nb, "future": fut,
+            "temperature": t,
+            "top_k": self._neutral_k if k is None else k,
+            "top_p": 1.0 if p is None else p,
+        })
         self._stats["requests"] += 1
         return fut
 
-    def generate(self, prompt_ids, max_new_tokens):
-        return self.submit(prompt_ids, max_new_tokens).result()
+    def generate(self, prompt_ids, max_new_tokens, **knobs):
+        return self.submit(prompt_ids, max_new_tokens, **knobs).result()
 
     def warmup(self) -> int:
         """Precompile the hot programs by RUNNING a dummy generation per
@@ -196,6 +238,10 @@ class GenerationService:
             for b in {self.batch_sizes[0], self.batch_sizes[-1]}:
                 prompts = jnp.ones((b, s), jnp.int32)
                 mask = jnp.ones((b, s), bool)
+                knobs = self._knob_rows(
+                    [{"temperature": 0.0, "top_k": self._neutral_k,
+                      "top_p": 1.0}] * b, b
+                )
                 if self.mesh is not None:
                     from mlcomp_tpu.parallel.mesh import batch_sharding
 
@@ -205,7 +251,7 @@ class GenerationService:
                 self._rng, sub = jax.random.split(self._rng)
                 fn = self._get_fn(b, s, nb)
                 out = fn(self.variables, prompt=prompts, prompt_mask=mask,
-                         rng=sub)
+                         rng=sub, **knobs)
                 int(out[0, -1])  # block until the program really ran
                 n += 1
         return n
@@ -223,6 +269,24 @@ class GenerationService:
         self._thread.join(timeout=5.0)
 
     # ------------------------------------------------------------ batcher
+
+    def _knob_rows(self, batch, b_bucket: int) -> Dict[str, Any]:
+        """Per-row sampling arrays for a batch; filler rows decode
+        greedily (their output is discarded — greedy is the cheapest)."""
+        import jax.numpy as jnp
+
+        t = np.zeros(b_bucket, np.float32)
+        k = np.full(b_bucket, self._neutral_k, np.int32)
+        p = np.ones(b_bucket, np.float32)
+        for r, item in enumerate(batch):
+            t[r] = item["temperature"]
+            k[r] = item["top_k"]
+            p[r] = item["top_p"]
+        return {
+            "temperature": jnp.asarray(t),
+            "top_k": jnp.asarray(k),
+            "top_p": jnp.asarray(p),
+        }
 
     def _get_fn(self, b: int, s: int, n_new: int):
         import functools
@@ -305,6 +369,7 @@ class GenerationService:
         self._rng, sub = jax.random.split(self._rng)
         fn = self._get_fn(b_bucket, s_bucket, nb)
         jprompts, jmask = jnp.asarray(prompts), jnp.asarray(mask)
+        knobs = self._knob_rows(batch, b_bucket)
         if self.mesh is not None:
             from mlcomp_tpu.parallel.mesh import batch_sharding
 
@@ -316,6 +381,7 @@ class GenerationService:
             prompt=jprompts,
             prompt_mask=jmask,
             rng=sub,
+            **knobs,
         ))
         latency_ms = (time.perf_counter() - t0) * 1e3
         self._stats["batches"] += 1
@@ -466,7 +532,10 @@ def serve_http(
                 req = json.loads(self.rfile.read(n) or b"{}")
                 prompt = req["prompt"]
                 fut = service.submit(
-                    prompt, int(req.get("max_new_tokens", 32))
+                    prompt, int(req.get("max_new_tokens", 32)),
+                    temperature=req.get("temperature"),
+                    top_k=req.get("top_k"),
+                    top_p=req.get("top_p"),
                 )
                 return self._json(fut.result(timeout=600))
             except (KeyError, ValueError, TypeError) as e:
