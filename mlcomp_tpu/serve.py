@@ -17,11 +17,12 @@ replacing it still needs to SERVE the model they trained.  This daemon
 - **weight residency**: weights load once, optionally int8-quantized
   with the Pallas kernel consuming them directly (``--quantize kernel``,
   the measured B=1 win) or pre-cast to bf16;
-- **per-request sampling**: temperature/top-k/top-p ride the compiled
-  program as per-ROW traced arrays (generation.py's rowwise path), so a
-  request can override the service defaults at ZERO recompile cost and
-  mixed-knob requests batch together; ``eos_id``/``pad_id`` stay
-  service-level (they are structural).
+- **per-request sampling**: temperature/top-k/top-p/eos_id ride the
+  compiled program as per-ROW traced arrays (generation.py's rowwise
+  path; eos compares broadcast, -1 = no eos), so a request can
+  override the service defaults at ZERO recompile cost and mixed-knob
+  requests batch together; ``pad_id`` stays service-level (it is
+  structural).
 
 Checkpoints resolve exactly like the generate executor: an explicit
 ``--ckpt`` directory, or the ModelStorage layout (``--storage-task``)
@@ -30,9 +31,10 @@ the train executor writes.
 HTTP surface (stdlib http.server, same conventions as report/server.py):
 
     POST /generate  {"prompt": [ids...], "max_new_tokens": 64,
-                     "temperature": 0.8, "top_k": 50, "top_p": 0.95}
+                     "temperature": 0.8, "top_k": 50, "top_p": 0.95,
+                     "eos_id": 2}
         -> {"ids": [...generated ids only...], "latency_ms": ...}
-        (sampling fields optional; default to the service config)
+        (sampling/eos fields optional; default to the service config)
     GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...}
 
 ``MLCOMP_TPU_SERVE_TOKEN`` (optional) demands ``Authorization: Bearer``
@@ -125,17 +127,20 @@ class GenerationService:
         self.max_new_buckets = tuple(sorted(max_new_buckets))
         self.batch_window_s = batch_window_ms / 1e3
         self.pad_id = int(pad_id)
-        # eos/pad are structural (trace into the program); the sampling
-        # knobs are per-ROW traced arrays (generation.py rowwise path),
-        # so per-request overrides share one compiled program per bucket
+        # pad_id is structural (traces into the program); the sampling
+        # knobs AND eos ride as per-ROW traced arrays (generation.py
+        # rowwise path / broadcast eos compare), so per-request
+        # overrides share one compiled program per bucket.  eos row
+        # neutral is -1: no vocab id matches, so "no eos" needs no
+        # separate program either.
         self.knobs: Dict[str, Any] = {
-            "eos_id": eos_id,
             "pad_id": int(pad_id),
         }
         self.defaults: Dict[str, Any] = {
             "temperature": float(temperature),
             "top_k": top_k,
             "top_p": top_p,
+            "eos_id": eos_id,
         }
         self._neutral_k = int(
             getattr(model, "vocab_size", None) or (1 << 30)
@@ -173,6 +178,7 @@ class GenerationService:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
     ) -> Future:
         """Enqueue one generation request; resolves to a list of the
         GENERATED ids (prompt excluded, truncated at the request's
@@ -203,6 +209,16 @@ class GenerationService:
         p = self.defaults["top_p"] if top_p is None else float(top_p)
         if p is not None and not 0.0 < p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {p}")
+        eos = self.defaults["eos_id"] if eos_id is None else int(eos_id)
+        if eos is not None and not 0 <= eos < 2**31:
+            if eos_id is None:
+                # a negative SERVICE default was always a "never
+                # matches" no-op — keep that, don't fail every request
+                eos = None
+            else:
+                raise ValueError(
+                    f"eos_id must be in [0, 2^31), got {eos}"
+                )
         # validate bucket fit NOW (caller thread) so errors surface as
         # request errors, not batcher crashes
         _bucket(len(ids), self.prompt_buckets, "prompt length")
@@ -213,6 +229,7 @@ class GenerationService:
             "temperature": t,
             "top_k": self._neutral_k if k is None else k,
             "top_p": 1.0 if p is None else p,
+            "eos_id": -1 if eos is None else eos,
         })
         self._stats["requests"] += 1
         return fut
@@ -278,14 +295,17 @@ class GenerationService:
         t = np.zeros(b_bucket, np.float32)
         k = np.full(b_bucket, self._neutral_k, np.int32)
         p = np.ones(b_bucket, np.float32)
+        e = np.full(b_bucket, -1, np.int32)
         for r, item in enumerate(batch):
             t[r] = item["temperature"]
             k[r] = item["top_k"]
             p[r] = item["top_p"]
+            e[r] = item.get("eos_id", -1)
         return {
             "temperature": jnp.asarray(t),
             "top_k": jnp.asarray(k),
             "top_p": jnp.asarray(p),
+            "eos_id": jnp.asarray(e),
         }
 
     def _get_fn(self, b: int, s: int, n_new: int):
@@ -386,10 +406,10 @@ class GenerationService:
         latency_ms = (time.perf_counter() - t0) * 1e3
         self._stats["batches"] += 1
         self._stats["batched_rows"] += len(batch)
-        eos = self.knobs["eos_id"]
         for r, item in enumerate(batch):
             gen = out[r, s_bucket:s_bucket + item["n_new"]].tolist()
-            if eos is not None and eos in gen:
+            eos = item.get("eos_id", -1)
+            if eos >= 0 and eos in gen:
                 gen = gen[: gen.index(eos) + 1]  # pads after EOS trimmed
             item["future"].set_result(
                 {"ids": gen, "latency_ms": round(latency_ms, 2),
@@ -536,6 +556,7 @@ def serve_http(
                     temperature=req.get("temperature"),
                     top_k=req.get("top_k"),
                     top_p=req.get("top_p"),
+                    eos_id=req.get("eos_id"),
                 )
                 return self._json(fut.result(timeout=600))
             except (KeyError, ValueError, TypeError) as e:

@@ -330,3 +330,26 @@ def test_serve_rejects_bad_knobs():
             svc.generate([1, 2], 4, top_p=1.5)
     finally:
         svc.close()
+
+
+def test_serve_per_request_eos():
+    """A request-level eos_id stops ITS row only; the neutral row runs
+    to its full budget — both in one batch/program."""
+    model, svc = _service(batch_window_ms=4000.0, batch_sizes=(1, 2))
+    try:
+        # find what greedy emits first so we can use it as the eos
+        probe = svc.generate([3, 14, 15, 9, 2], 4)
+        first = probe["ids"][0]
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(2) as ex:
+            f1 = ex.submit(
+                svc.generate, [3, 14, 15, 9, 2], 4, eos_id=first
+            )
+            f2 = ex.submit(svc.generate, [7, 3, 44], 4)
+            r1, r2 = f1.result(), f2.result()
+        assert r1["ids"] == [first]  # stopped at its own eos
+        assert len(r2["ids"]) == 4   # unaffected neighbor
+        assert r1["batched_with"] == 2
+    finally:
+        svc.close()
