@@ -211,13 +211,16 @@ class GenerationService:
             raise ValueError(f"top_p must be in (0, 1], got {p}")
         eos = self.defaults["eos_id"] if eos_id is None else int(eos_id)
         if eos is not None and not 0 <= eos < 2**31:
-            if eos_id is None:
-                # a negative SERVICE default was always a "never
-                # matches" no-op — keep that, don't fail every request
+            if eos == -1 or eos_id is None:
+                # -1 is the documented per-request "no eos" opt-out
+                # (run the full budget even when the service has a
+                # default); a negative SERVICE default keeps its
+                # historical never-matches no-op meaning
                 eos = None
             else:
                 raise ValueError(
-                    f"eos_id must be in [0, 2^31), got {eos}"
+                    f"eos_id must be in [0, 2^31), or -1 for none; "
+                    f"got {eos}"
                 )
         # validate bucket fit NOW (caller thread) so errors surface as
         # request errors, not batcher crashes

@@ -40,6 +40,7 @@ def make_train_step(
     rng_key: Optional[jax.Array] = None,
     grad_accum: int = 1,
     augment_fn=None,
+    mixup_alpha: float = 0.0,
 ):
     """Build the pure train step; jitted once, reused every step.
 
@@ -72,6 +73,46 @@ def make_train_step(
                 jax.random.fold_in(base_key, 0x5EED), state.step
             )
             batch = {**batch, "x": augment_fn(aug_key, batch["x"])}
+        mix = None
+        if mixup_alpha > 0.0:
+            # mixup (the Catalyst MixupCallback analog, in-step): blend
+            # each example with a permuted partner; the loss becomes the
+            # same convex combination of the two label sets — exact for
+            # CE-family losses (linear in the target distribution), the
+            # standard recipe elsewhere.  One shared lambda per step
+            # (the common implementation; per-example lambdas mix
+            # poorly with masked losses).  Metrics score against the
+            # DOMINANT label, mirroring torch-world practice.
+            mkey = jax.random.fold_in(
+                jax.random.fold_in(base_key, 0xA11C), state.step
+            )
+            k_lam, k_perm = jax.random.split(mkey)
+            if "y" not in batch:
+                raise ValueError(
+                    "mixup needs labeled batches (y); it is a "
+                    "classification recipe — drop it for LM/unlabeled "
+                    "training"
+                )
+            if not jnp.issubdtype(batch["x"].dtype, jnp.floating):
+                raise ValueError(
+                    f"mixup blends float inputs; x is "
+                    f"{batch['x'].dtype} (token ids?) — an integer "
+                    "blend would silently zero the batch"
+                )
+            lam = jax.random.beta(k_lam, mixup_alpha, mixup_alpha)
+            lam = jnp.maximum(lam, 1.0 - lam)  # dominant first operand
+            perm = jax.random.permutation(k_perm, batch["x"].shape[0])
+            xb = batch["x"]
+            # the partner labels ride IN the batch so a grad_accum split
+            # keeps each row's partner in its microbatch; metrics score
+            # against the dominant (original) y
+            batch = {
+                **batch,
+                "x": lam.astype(xb.dtype) * xb
+                + (1.0 - lam).astype(xb.dtype) * xb[perm],
+                "_mix_y": batch["y"][perm],
+            }
+            mix = lam
 
         def grads_of(params, model_state, batch, step_rngs):
             def loss_of(params):
@@ -89,6 +130,12 @@ def make_train_step(
                 new_model_state = dict(new_model_state)
                 sown = new_model_state.pop("losses", {})
                 loss = loss_fn(outputs, batch)
+                if mix is not None:
+                    # convex label combination — exact mixup for
+                    # CE-family losses (linear in the target dist)
+                    loss = mix * loss + (1.0 - mix) * loss_fn(
+                        outputs, {**batch, "y": batch["_mix_y"]}
+                    )
                 for leaf in jax.tree.leaves(sown):
                     loss = loss + jnp.sum(leaf)
                 return loss, (outputs, new_model_state)
@@ -267,6 +314,7 @@ class Trainer:
                 rng_key=jax.random.PRNGKey(self.seed + 1),
                 grad_accum=int(cfg.get("grad_accum", 1)),
                 augment_fn=build_augment(cfg.get("augment")),
+                mixup_alpha=float(cfg.get("mixup", 0.0) or 0.0),
             ),
             donate_argnums=(0,),
         )

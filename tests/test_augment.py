@@ -101,3 +101,69 @@ def test_trainer_augment_integration():
     ).train_epoch()
     assert np.isfinite(auged["loss"])
     assert auged["loss"] != plain["loss"]  # pixels really changed
+
+
+def test_mixup_trains_and_blends():
+    """mixup changes the training loss (the blend really happens) and
+    composes with grad_accum (partner labels ride the microbatch)."""
+    from mlcomp_tpu.train.loop import Trainer
+
+    def cfg(mixup):
+        return {
+            "model": {"name": "mlp", "num_classes": 4, "hidden": [16]},
+            "optimizer": {"name": "sgd", "lr": 0.0},
+            "loss": "cross_entropy",
+            "metrics": ["accuracy"],
+            "epochs": 1,
+            "seed": 0,
+            "mixup": mixup,
+            "data": {
+                "train": {
+                    "name": "synthetic_classification", "n": 64,
+                    "num_classes": 4, "batch_size": 32,
+                }
+            },
+        }
+
+    plain = Trainer(cfg(0.0)).train_epoch()
+    mixed = Trainer(cfg(0.4)).train_epoch()
+    assert np.isfinite(mixed["loss"])
+    assert mixed["loss"] != plain["loss"]
+
+    # grad_accum composes: partner rows travel with their microbatch
+    c = cfg(0.4)
+    c["grad_accum"] = 2
+    acc = Trainer(c).train_epoch()
+    assert np.isfinite(acc["loss"])
+
+
+def test_mixup_refuses_unlabeled():
+    from mlcomp_tpu.train.loop import make_train_step
+
+    step = make_train_step(
+        lambda out, batch: jnp.mean(out), {}, mixup_alpha=0.2
+    )
+
+    class FakeState:
+        step = 0
+
+    with pytest.raises(ValueError, match="labeled"):
+        step(FakeState(), {"x": jnp.zeros((4, 8))})
+
+
+def test_mixup_refuses_integer_inputs():
+    """Token-id x with labels would silently blend to zeros; refuse."""
+    from mlcomp_tpu.train.loop import make_train_step
+
+    step = make_train_step(
+        lambda out, batch: jnp.mean(out), {}, mixup_alpha=0.2
+    )
+
+    class FakeState:
+        step = 0
+
+    with pytest.raises(ValueError, match="float"):
+        step(
+            FakeState(),
+            {"x": jnp.zeros((4, 8), jnp.int32), "y": jnp.zeros(4, jnp.int32)},
+        )
