@@ -180,7 +180,8 @@ def generate(
     rng: Optional[jax.Array] = None,
     weights_dtype=None,
     quant_kernel: bool = False,
-) -> jax.Array:
+    with_logprobs: bool = False,
+):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
     - ``variables``: the model's non-cache variables ({"params": ...});
@@ -203,13 +204,20 @@ def generate(
       mixed requests with.
 
     Returns (B, S + max_new_tokens) int32 ids (prompt included; padding
-    preserved as given).
+    preserved as given).  With ``with_logprobs=True`` (static — a
+    second program variant) returns ``(ids, logprobs)`` where logprobs
+    is (B, max_new_tokens) f32: the RAW-model log-probability of each
+    emitted token (log_softmax of the unfiltered, untempered logits —
+    the serving-API convention, so values are comparable across
+    sampling settings); rows already past EOS report 0.0.
     """
     from mlcomp_tpu.ops.quant import dequantize_params, has_quantized
 
     prompt = prompt.astype(jnp.int32)
     b, s = prompt.shape
     if max_new_tokens <= 0:
+        if with_logprobs:
+            return prompt, jnp.zeros((b, 0), jnp.float32)
         return prompt
     total = s + max_new_tokens
     cache = init_cache(model, b, total)
@@ -331,14 +339,22 @@ def generate(
         else:
             tok = sample_token(rng, logits, temperature, top_k, top_p)
         tok = jnp.where(done, jnp.int32(pad_id), tok)
+        if with_logprobs:
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                tok[:, None], axis=-1,
+            )[:, 0]
+            lp = jnp.where(done, 0.0, lp)
+        else:
+            lp = jnp.zeros((tok.shape[0],), jnp.float32)
         if eos_id is not None:
             done = done | (tok == eos_id)
-        return tok, done
+        return tok, lp, done
 
     def step(carry, _):
         cache, last_logits, done, pos, rng = carry
         rng, sub = jax.random.split(rng)
-        tok, done = next_token(sub, last_logits, done)
+        tok, lp, done = next_token(sub, last_logits, done)
         logits, updated = apply_model(
             model_vars(cache),
             tok[:, None],
@@ -347,18 +363,24 @@ def generate(
             kv_mask=kv_mask,
             mutable=["cache"],
         )
-        return (updated["cache"], logits[:, -1], done, pos + 1, rng), tok
+        return (
+            (updated["cache"], logits[:, -1], done, pos + 1, rng),
+            (tok, lp),
+        )
 
     # N-1 scan steps (each samples, then forwards to produce the next
     # logits); the final token needs no forward pass of its own
     done0 = jnp.zeros((b,), jnp.bool_)
-    (_, last_logits, done, _, rng), tokens = jax.lax.scan(
+    (_, last_logits, done, _, rng), (tokens, lps) = jax.lax.scan(
         step,
         (cache, last_logits, done0, real_len, rng),
         None,
         length=max_new_tokens - 1,
     )
     rng, sub = jax.random.split(rng)
-    final, _ = next_token(sub, last_logits, done)
+    final, final_lp, _ = next_token(sub, last_logits, done)
     tokens = jnp.concatenate([tokens.T, final[:, None]], axis=1)
-    return jnp.concatenate([prompt, tokens], axis=1)
+    ids = jnp.concatenate([prompt, tokens], axis=1)
+    if with_logprobs:
+        return ids, jnp.concatenate([lps.T, final_lp[:, None]], axis=1)
+    return ids

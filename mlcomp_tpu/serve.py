@@ -32,9 +32,12 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
 
     POST /generate  {"prompt": [ids...], "max_new_tokens": 64,
                      "temperature": 0.8, "top_k": 50, "top_p": 0.95,
-                     "eos_id": 2}
-        -> {"ids": [...generated ids only...], "latency_ms": ...}
-        (sampling/eos fields optional; default to the service config)
+                     "eos_id": 2, "logprobs": true}
+        -> {"ids": [...generated ids only...], "latency_ms": ...,
+            "logprobs": [...raw-model log-probs per emitted token...]}
+        (sampling/eos/logprobs fields optional; logprobs are
+        log_softmax of the unfiltered logits — comparable across
+        sampling settings)
     GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...}
 
 ``MLCOMP_TPU_SERVE_TOKEN`` (optional) demands ``Authorization: Bearer``
@@ -179,6 +182,7 @@ class GenerationService:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
+        logprobs: bool = False,
     ) -> Future:
         """Enqueue one generation request; resolves to a list of the
         GENERATED ids (prompt excluded, truncated at the request's
@@ -209,6 +213,12 @@ class GenerationService:
         p = self.defaults["top_p"] if top_p is None else float(top_p)
         if p is not None and not 0.0 < p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {p}")
+        if not isinstance(logprobs, bool):
+            # strict like the other fields: a string "false" silently
+            # coercing to True would mask client bugs
+            raise ValueError(
+                f"logprobs must be a JSON boolean, got {logprobs!r}"
+            )
         eos = self.defaults["eos_id"] if eos_id is None else int(eos_id)
         if eos is not None and not 0 <= eos < 2**31:
             if eos == -1 or eos_id is None:
@@ -233,6 +243,7 @@ class GenerationService:
             "top_k": self._neutral_k if k is None else k,
             "top_p": 1.0 if p is None else p,
             "eos_id": -1 if eos is None else eos,
+            "logprobs": bool(logprobs),
         })
         self._stats["requests"] += 1
         return fut
@@ -270,8 +281,8 @@ class GenerationService:
                     mask = jax.device_put(mask, sh)
                 self._rng, sub = jax.random.split(self._rng)
                 fn = self._get_fn(b, s, nb)
-                out = fn(self.variables, prompt=prompts, prompt_mask=mask,
-                         rng=sub, **knobs)
+                out, _ = fn(self.variables, prompt=prompts,
+                            prompt_mask=mask, rng=sub, **knobs)
                 int(out[0, -1])  # block until the program really ran
                 n += 1
         return n
@@ -322,7 +333,12 @@ class GenerationService:
         if key not in self._fns:
             self._fns[key] = jax.jit(
                 functools.partial(
-                    generate, self.model, max_new_tokens=n_new, **self.knobs,
+                    generate, self.model, max_new_tokens=n_new,
+                    # always-on: one log_softmax gather per token is
+                    # noise next to the HBM-bound decode, and ONE
+                    # program variant per bucket beats two
+                    with_logprobs=True,
+                    **self.knobs,
                 )
             )
         return self._fns[key]
@@ -399,13 +415,14 @@ class GenerationService:
             sh = batch_sharding(self.mesh)
             jprompts = jax.device_put(jprompts, sh)
             jmask = jax.device_put(jmask, sh)
-        out = np.asarray(fn(
+        out, lps = fn(
             self.variables,
             prompt=jprompts,
             prompt_mask=jmask,
             rng=sub,
             **knobs,
-        ))
+        )
+        out, lps = np.asarray(out), np.asarray(lps)
         latency_ms = (time.perf_counter() - t0) * 1e3
         self._stats["batches"] += 1
         self._stats["batched_rows"] += len(batch)
@@ -414,10 +431,13 @@ class GenerationService:
             eos = item.get("eos_id", -1)
             if eos >= 0 and eos in gen:
                 gen = gen[: gen.index(eos) + 1]  # pads after EOS trimmed
-            item["future"].set_result(
-                {"ids": gen, "latency_ms": round(latency_ms, 2),
-                 "batched_with": len(batch)}
-            )
+            result = {"ids": gen, "latency_ms": round(latency_ms, 2),
+                      "batched_with": len(batch)}
+            if item.get("logprobs"):
+                result["logprobs"] = [
+                    round(float(v), 5) for v in lps[r, : len(gen)]
+                ]
+            item["future"].set_result(result)
 
 
 # --------------------------------------------------------------- loading
@@ -560,6 +580,7 @@ def serve_http(
                     top_k=req.get("top_k"),
                     top_p=req.get("top_p"),
                     eos_id=req.get("eos_id"),
+                    logprobs=req.get("logprobs", False),
                 )
                 return self._json(fut.result(timeout=600))
             except (KeyError, ValueError, TypeError) as e:

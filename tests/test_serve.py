@@ -353,3 +353,26 @@ def test_serve_per_request_eos():
         assert r1["batched_with"] == 2
     finally:
         svc.close()
+
+
+def test_serve_logprobs():
+    """Requested logprobs align with the emitted ids and equal the
+    model's own log-softmax of the greedy logits; requests without the
+    flag get no logprobs field."""
+    model, svc = _service()
+    try:
+        prompt = [3, 14, 15, 9, 2]
+        r = svc.generate(prompt, 3, logprobs=True)
+        assert "logprobs" in r and len(r["logprobs"]) == len(r["ids"])
+        assert all(v <= 0.0 for v in r["logprobs"])
+        # cross-check the first step against a bare forward
+        logits = model.apply(
+            svc.variables, jnp.asarray([prompt], jnp.int32)
+        )[0, -1]
+        expect = float(jax.nn.log_softmax(
+            logits.astype(jnp.float32))[r["ids"][0]])
+        assert abs(r["logprobs"][0] - expect) < 1e-3
+        plain = svc.generate(prompt, 3)
+        assert "logprobs" not in plain
+    finally:
+        svc.close()
