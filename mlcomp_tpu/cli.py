@@ -348,6 +348,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         temperature=args.temperature,
         top_k=args.top_k,
         top_p=args.top_p,
+        repetition_penalty=args.repetition_penalty,
         eos_id=args.eos_id,
         pad_id=args.pad_id,
         quantize=args.quantize or False,
@@ -528,6 +529,7 @@ def main(argv=None) -> int:
     sv.add_argument("--temperature", type=float, default=0.0)
     sv.add_argument("--top-k", type=int, default=None)
     sv.add_argument("--top-p", type=float, default=None)
+    sv.add_argument("--repetition-penalty", type=float, default=1.0)
     sv.add_argument("--eos-id", type=int, default=None)
     sv.add_argument("--pad-id", type=int, default=0)
     sv.add_argument(

@@ -181,6 +181,7 @@ def generate(
     weights_dtype=None,
     quant_kernel: bool = False,
     with_logprobs: bool = False,
+    repetition_penalty: Optional[jax.Array] = None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
@@ -202,6 +203,12 @@ def generate(
       arrays optional then, neutral per row when omitted): one compiled
       program serves any knob mix — what the serving daemon batches
       mixed requests with.
+    - ``repetition_penalty`` (rowwise only, (B,) floats, 1.0 = off):
+      tokens already seen (real prompt ids + everything generated so
+      far, tracked as a (B, V) presence mask carried through the scan)
+      get the HF-convention adjustment (positive logits divided,
+      negative multiplied) BEFORE greedy/sampling; reported logprobs
+      stay raw-model.
 
     Returns (B, S + max_new_tokens) int32 ids (prompt included; padding
     preserved as given).  With ``with_logprobs=True`` (static — a
@@ -332,10 +339,26 @@ def generate(
             jnp.ones((b,), jnp.float32) if top_p is None
             else row(top_p, jnp.float32)
         )
+        rp_row = (
+            None if repetition_penalty is None
+            else row(repetition_penalty, jnp.float32)
+        )
+    elif repetition_penalty is not None:
+        raise ValueError(
+            "repetition_penalty needs the rowwise sampling path — pass "
+            "temperature as a (B,) array (see the sampling-knobs note)"
+        )
 
-    def next_token(rng, logits, done):
+    def next_token(rng, logits, done, presence=None):
         if rowwise:
-            tok = sample_token_rowwise(rng, logits, t_row, k_row, p_row)
+            adj = logits
+            if presence is not None:
+                rp = rp_row[:, None]
+                la = adj.astype(jnp.float32)
+                adj = jnp.where(
+                    presence, jnp.where(la > 0, la / rp, la * rp), la
+                )
+            tok = sample_token_rowwise(rng, adj, t_row, k_row, p_row)
         else:
             tok = sample_token(rng, logits, temperature, top_k, top_p)
         tok = jnp.where(done, jnp.int32(pad_id), tok)
@@ -351,10 +374,30 @@ def generate(
             done = done | (tok == eos_id)
         return tok, lp, done
 
+    use_rp = rowwise and repetition_penalty is not None
+    if use_rp:
+        # (B, V) seen-token mask: real prompt ids seed it (left-pads
+        # excluded via prompt_mask), each sampled token joins its row
+        vocab_v = last_logits.shape[-1]
+        rows = jnp.arange(b)[:, None]
+        seeds = (
+            pm if prompt_mask is not None
+            else jnp.ones((b, s), jnp.bool_)
+        )
+        presence0 = jnp.zeros((b, vocab_v), jnp.bool_).at[
+            rows, prompt
+        ].max(seeds)
+    else:
+        presence0 = jnp.zeros((b, 1), jnp.bool_)  # carry placeholder
+
     def step(carry, _):
-        cache, last_logits, done, pos, rng = carry
+        cache, last_logits, done, pos, rng, presence = carry
         rng, sub = jax.random.split(rng)
-        tok, lp, done = next_token(sub, last_logits, done)
+        tok, lp, new_done = next_token(
+            sub, last_logits, done, presence if use_rp else None
+        )
+        if use_rp:
+            presence = presence.at[jnp.arange(b), tok].max(~done)
         logits, updated = apply_model(
             model_vars(cache),
             tok[:, None],
@@ -364,21 +407,24 @@ def generate(
             mutable=["cache"],
         )
         return (
-            (updated["cache"], logits[:, -1], done, pos + 1, rng),
+            (updated["cache"], logits[:, -1], new_done, pos + 1, rng,
+             presence),
             (tok, lp),
         )
 
     # N-1 scan steps (each samples, then forwards to produce the next
     # logits); the final token needs no forward pass of its own
     done0 = jnp.zeros((b,), jnp.bool_)
-    (_, last_logits, done, _, rng), (tokens, lps) = jax.lax.scan(
+    (_, last_logits, done, _, rng, presence), (tokens, lps) = jax.lax.scan(
         step,
-        (cache, last_logits, done0, real_len, rng),
+        (cache, last_logits, done0, real_len, rng, presence0),
         None,
         length=max_new_tokens - 1,
     )
     rng, sub = jax.random.split(rng)
-    final, final_lp, _ = next_token(sub, last_logits, done)
+    final, final_lp, _ = next_token(
+        sub, last_logits, done, presence if use_rp else None
+    )
     tokens = jnp.concatenate([tokens.T, final[:, None]], axis=1)
     ids = jnp.concatenate([prompt, tokens], axis=1)
     if with_logprobs:

@@ -91,6 +91,7 @@ class GenerationService:
         quantize: "bool | str" = False,
         seed: int = 0,
         mesh=None,
+        repetition_penalty: float = 1.0,
     ):
         import jax
 
@@ -144,6 +145,7 @@ class GenerationService:
             "top_k": top_k,
             "top_p": top_p,
             "eos_id": eos_id,
+            "repetition_penalty": float(repetition_penalty),
         }
         self._neutral_k = int(
             getattr(model, "vocab_size", None) or (1 << 30)
@@ -183,6 +185,7 @@ class GenerationService:
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         logprobs: bool = False,
+        repetition_penalty: Optional[float] = None,
     ) -> Future:
         """Enqueue one generation request; resolves to a list of the
         GENERATED ids (prompt excluded, truncated at the request's
@@ -213,6 +216,14 @@ class GenerationService:
         p = self.defaults["top_p"] if top_p is None else float(top_p)
         if p is not None and not 0.0 < p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {p}")
+        rp = (
+            self.defaults["repetition_penalty"]
+            if repetition_penalty is None else float(repetition_penalty)
+        )
+        if not 0.0 < rp <= 10.0:
+            raise ValueError(
+                f"repetition_penalty must be in (0, 10], got {rp}"
+            )
         if not isinstance(logprobs, bool):
             # strict like the other fields: a string "false" silently
             # coercing to True would mask client bugs
@@ -244,6 +255,7 @@ class GenerationService:
             "top_p": 1.0 if p is None else p,
             "eos_id": -1 if eos is None else eos,
             "logprobs": bool(logprobs),
+            "repetition_penalty": rp,
         })
         self._stats["requests"] += 1
         return fut
@@ -310,16 +322,19 @@ class GenerationService:
         k = np.full(b_bucket, self._neutral_k, np.int32)
         p = np.ones(b_bucket, np.float32)
         e = np.full(b_bucket, -1, np.int32)
+        rp = np.ones(b_bucket, np.float32)
         for r, item in enumerate(batch):
             t[r] = item["temperature"]
             k[r] = item["top_k"]
             p[r] = item["top_p"]
             e[r] = item.get("eos_id", -1)
+            rp[r] = item.get("repetition_penalty", 1.0)
         return {
             "temperature": jnp.asarray(t),
             "top_k": jnp.asarray(k),
             "top_p": jnp.asarray(p),
             "eos_id": jnp.asarray(e),
+            "repetition_penalty": jnp.asarray(rp),
         }
 
     def _get_fn(self, b: int, s: int, n_new: int):
@@ -581,6 +596,7 @@ def serve_http(
                     top_p=req.get("top_p"),
                     eos_id=req.get("eos_id"),
                     logprobs=req.get("logprobs", False),
+                    repetition_penalty=req.get("repetition_penalty"),
                 )
                 return self._json(fut.result(timeout=600))
             except (KeyError, ValueError, TypeError) as e:

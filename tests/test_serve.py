@@ -376,3 +376,14 @@ def test_serve_logprobs():
         assert "logprobs" not in plain
     finally:
         svc.close()
+
+
+def test_serve_repetition_penalty_knob():
+    _, svc = _service()
+    try:
+        r = svc.generate([3, 14, 15, 9, 2], 3, repetition_penalty=1.3)
+        assert len(r["ids"]) == 3
+        with pytest.raises(ValueError, match="repetition_penalty"):
+            svc.generate([1, 2], 3, repetition_penalty=0.0)
+    finally:
+        svc.close()
