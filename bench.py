@@ -285,6 +285,12 @@ def bench_decode() -> None:
     from mlcomp_tpu.ops.quant import quantize_params
     from mlcomp_tpu.train.state import init_model
 
+    # round-4: ALL variants run the decode_fused layout (fused qkv +
+    # gate_up serving projections, bit-identical math) — at decode GEMV
+    # shapes the per-kernel-call overhead of 7 thin projections/layer was
+    # measured at 59% of the weight-bytes roofline vs 88% fused with the
+    # auto block heuristic (quant_matmul._auto_blocks); the bf16 variants
+    # share the layout so one stored int8 tree serves every mode.
     lm_cfg = {
         "name": "transformer_lm",
         "vocab_size": LM_VOCAB,
@@ -293,6 +299,7 @@ def bench_decode() -> None:
         "heads": LM_HEADS,
         "mlp_dim": 4 * LM_HIDDEN,
         "dtype": "bfloat16",
+        "decode_fused": True,
     }
     model = create_model(lm_cfg)
     # round-3: int8 KV cache (ops/pallas/decode_attention.py) — attacks
@@ -308,6 +315,8 @@ def bench_decode() -> None:
     params, _ = init_model(
         model, {"x": prompts[1][:, :128]}, jax.random.PRNGKey(0)
     )
+    # params come out of init_model already in the fused layout (real
+    # checkpoints convert via models.transformer.fuse_decode_params)
     qvars = {"params": quantize_params(params)}
     del params  # one stored copy: int8 (+fp32 small leaves); the bf16
     # variant dequantizes at entry INSIDE its jitted program

@@ -153,6 +153,15 @@ def build_augment(
             raise ValueError(
                 f"augment expects NHWC images, got shape {x.shape}"
             )
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            # same guard as mixup (train/loop.py): a U[1-s,1+s] factor
+            # truncates to 0 or 1 on integer pixels (uint8 blacks out
+            # half the batch) and the crop/contrast float round-trips
+            # would quantize silently — normalize to float first
+            raise ValueError(
+                f"augment expects float images; x is {x.dtype} — "
+                "scale/normalize to float before augmenting"
+            )
         keys = jax.random.split(rng, 4)
         if use_rrc:
             x = _random_resized_crop(keys[0], x, rrc_scale, rrc_ratio)

@@ -58,25 +58,61 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, out_dtype):
         o_ref[:] = (acc_ref[:] * s_ref[0:1]).astype(out_dtype)
 
 
+# VMEM budget for one weight block: 4 MB double-buffers inside the
+# ~16 MB/core budget next to x/scale/acc blocks
+_MAX_BLOCK_BYTES = 4 * 1024 * 1024
+_GEMV_ROWS = 64  # row count at or below which the decode heuristic kicks in
+
+
+def _auto_blocks(b: int, d: int, n: int):
+    """Block sizes for the (rows, contraction, out) problem shape.
+
+    Decode GEMVs (rows <= _GEMV_ROWS) are per-GRID-STEP-overhead bound,
+    not bandwidth bound: a (8, 2048)x(2048, 2048) call at the round-3
+    512x512 default runs 16 grid steps of 256 KB and measures 9.2 us
+    where the HBM roofline is 5.1 us; the same bytes in 4 fat steps
+    measure 3.3-6.6 us (tools-sweep, v5e, marginal fori_loop timing —
+    the same "few fat grid steps" finding decode_attention.py documents).
+    Aim for ~4 grid steps per call, capped at _MAX_BLOCK_BYTES per
+    weight block: block_d = full D up to 4096, block_n sized so
+    steps_d * steps_n ~= 4.  Larger row counts (prefill interception)
+    keep the measured round-2 512x512 default — there the x/acc blocks
+    share VMEM and bandwidth, and fat weight blocks would evict them.
+    """
+    if b > _GEMV_ROWS:
+        return 512, 512
+    block_d = min(d, 4096)
+    steps_d = -(-d // block_d)
+    want_n = max(1, 4 // steps_d)
+    block_n = max(LANES, min(n // want_n, _MAX_BLOCK_BYTES // block_d))
+    return block_n, block_d
+
+
 def quant_matmul(
     x: jax.Array,
     q8: jax.Array,
     scale: jax.Array,
-    block_n: int = 512,
-    block_d: int = 512,
+    block_n: int | None = None,
+    block_d: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """``x @ (q8 * scale)`` with the dequant fused into the kernel.
 
     x: (B, D) float (bf16/f32); q8: (D, N) int8; scale: (D-broadcastable,
     N) or (N,) float — per-output-channel.  Returns (B, N) in x.dtype.
-    Falls back (NotImplementedError) when D or N don't tile; the caller
+    ``block_n``/``block_d`` default to a shape-dependent heuristic (see
+    :func:`_auto_blocks`); pass them to pin a layout.  Falls back
+    (NotImplementedError) when D or N don't tile; the caller
     (ops/quant.py dispatch) keeps the XLA path for those.
     """
     b, d = x.shape
     d2, n = q8.shape
     if d != d2:
         raise ValueError(f"contraction mismatch: x {x.shape} vs q8 {q8.shape}")
+    if block_n is None or block_d is None:
+        auto_n, auto_d = _auto_blocks(b, d, n)
+        block_n = auto_n if block_n is None else block_n
+        block_d = auto_d if block_d is None else block_d
     # accept only per-output-channel layouts: (n,) or (1, n).  A scale
     # that merely has n elements (e.g. a per-input-row (d, 1) on a square
     # kernel) would silently produce wrong outputs — the kernel assumes
@@ -130,7 +166,8 @@ def quant_matmul(
 
 
 def _fit_block(dim: int, preferred: int):
-    for blk in (preferred, 512, 256, LANES):
-        if blk <= preferred and dim % blk == 0:
+    """Largest lane-multiple block <= preferred that divides ``dim``."""
+    for blk in range(min(preferred, dim) // LANES * LANES, 0, -LANES):
+        if dim % blk == 0:
             return blk
     return None

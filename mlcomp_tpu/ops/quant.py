@@ -35,7 +35,9 @@ _SKEY = "q8_scale"
 # flax param-path naming of the 3-D DenseGeneral attention projections
 # (models/transformer.py, models/bert.py): q/k/v kernels are (d, H, dh)
 # contracting d; out kernels are (H, dh, d) contracting (H, dh).
-_ATTN_IN_KEYS = ("q", "k", "v", "query", "key", "value")
+# "qkv" is the decode_fused fused projection (transformer.py), same
+# (d, Ht, dh) layout with Ht = H + 2*Hkv.
+_ATTN_IN_KEYS = ("q", "k", "v", "qkv", "query", "key", "value")
 _ATTN_OUT_KEYS = ("out", "o", "out_proj")
 
 

@@ -282,8 +282,14 @@ class GenerationService:
                 prompts = jnp.ones((b, s), jnp.int32)
                 mask = jnp.ones((b, s), bool)
                 knobs = self._knob_rows(
+                    # carry the service's penalty default like real
+                    # requests do: with a non-1.0 default every real
+                    # batch runs the penalty program variant, and THAT
+                    # is the one warmup must precompile
                     [{"temperature": 0.0, "top_k": self._neutral_k,
-                      "top_p": 1.0}] * b, b
+                      "top_p": 1.0,
+                      "repetition_penalty":
+                          self.defaults["repetition_penalty"]}] * b, b
                 )
                 if self.mesh is not None:
                     from mlcomp_tpu.parallel.mesh import batch_sharding
@@ -310,6 +316,15 @@ class GenerationService:
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5.0)
+        if getattr(self, "_owns_process_mesh", False):
+            # load_service installed the mesh process-wide (model code
+            # reads current_mesh() for shard_map paths); un-install it so
+            # a later mesh-less service or other model code in this
+            # process doesn't inherit a stale mesh
+            from mlcomp_tpu.parallel.mesh import set_current_mesh
+
+            set_current_mesh(None)
+            self._owns_process_mesh = False
 
     # ------------------------------------------------------------ batcher
 
@@ -329,13 +344,21 @@ class GenerationService:
             p[r] = item["top_p"]
             e[r] = item.get("eos_id", -1)
             rp[r] = item.get("repetition_penalty", 1.0)
-        return {
+        rows = {
             "temperature": jnp.asarray(t),
             "top_k": jnp.asarray(k),
             "top_p": jnp.asarray(p),
             "eos_id": jnp.asarray(e),
-            "repetition_penalty": jnp.asarray(rp),
         }
+        if not np.all(rp == 1.0):
+            # the penalty machinery costs a (B, V) presence mask through
+            # the scan plus a per-token scatter/select on the hot decode
+            # path — only trace it in when some row actually asks
+            # (generate() keys the machinery on the ARG being present, so
+            # with/without is two jit cache entries per bucket; warmup
+            # precompiles the common penalty-free one)
+            rows["repetition_penalty"] = jnp.asarray(rp)
+        return rows
 
     def _get_fn(self, b: int, s: int, n_new: int):
         import functools
@@ -518,9 +541,13 @@ def load_service(
         from mlcomp_tpu.io.checkpoint import restore_eval_state
 
         state = restore_eval_state(ckpt_dir, state)
-    return GenerationService(
+    service = GenerationService(
         model, state.eval_variables, mesh=mesh, **service_kw
     )
+    # this service installed the process-wide mesh above; close() resets
+    # it (one live mesh-serving GenerationService per process)
+    service._owns_process_mesh = mesh is not None
+    return service
 
 
 def resolve_storage_ckpt(project: str, dag_name: str, task: str) -> str:

@@ -329,3 +329,83 @@ def test_moe_train_rejects_quantized_experts():
     qp["experts_w2"] = quantize_leaf(params["experts_w2"])
     with _pytest.raises(ValueError, match="decode-only"):
         block.apply({"params": qp}, x, train=True)
+
+
+def test_fuse_decode_params_generation_equal():
+    """Round 4: decode_fused (fused qkv + gate_up serving projections)
+    generates the SAME greedy tokens as the standard layout, for raw
+    weights and for the int8 kernel path; quantize-then-fuse equals
+    fuse-then-quantize exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.generation import generate
+    from mlcomp_tpu.models.transformer import fuse_decode_params
+    from mlcomp_tpu.ops.quant import is_quantized_leaf, quantize_params
+
+    cfg = {
+        "name": "transformer_lm", "vocab_size": 128, "hidden": 256,
+        "layers": 2, "heads": 2, "mlp_dim": 512, "dtype": "float32",
+    }
+    model = create_model(cfg)
+    fused_model = create_model({**cfg, "decode_fused": True})
+    ids = jnp.asarray(np.random.RandomState(5).randint(1, 128, (2, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    fparams = fuse_decode_params(params)
+
+    attn = fparams["DecoderLayer_0"]["attn"]
+    assert "qkv" in attn and "q" not in attn
+    assert attn["qkv"]["kernel"].shape == (256, 6, 128)  # H + 2*Hkv = 6
+    layer = fparams["DecoderLayer_0"]
+    assert "gate_up" in layer and "gate" not in layer
+    assert layer["gate_up"]["kernel"].shape == (256, 1024)
+
+    base = generate(model, {"params": params}, ids, 6)
+    fused = generate(fused_model, {"params": fparams}, ids, 6)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(fused))
+
+    # quantize-then-fuse == fuse-then-quantize (bit-exact: per-output-
+    # channel scales are unaffected by output-axis concatenation)
+    qf = fuse_decode_params(quantize_params(params, min_size=1024))
+    fq = quantize_params(fparams, min_size=1024)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        qf, fq,
+    )
+    qkv_leaf = fq["DecoderLayer_0"]["attn"]["qkv"]["kernel"]
+    assert is_quantized_leaf(qkv_leaf)
+    assert qkv_leaf["q8_scale"].shape == (1, 6, 128)
+
+    base_q = generate(model, {"params": quantize_params(params, min_size=1024)},
+                      ids, 6, quant_kernel=True)
+    fused_q = generate(fused_model, {"params": fq}, ids, 6, quant_kernel=True)
+    np.testing.assert_array_equal(np.asarray(base_q), np.asarray(fused_q))
+
+
+def test_fused_qkv_stays_int8_through_nonkernel_dequant():
+    """The fused qkv/gate_up kernels are recognized by the interception
+    path rules: they survive dequantize_nonkernel_params as int8."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.transformer import fuse_decode_params
+    from mlcomp_tpu.ops.quant import (
+        dequantize_nonkernel_params,
+        is_quantized_leaf,
+        quantize_params,
+    )
+
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 128, "hidden": 256,
+        "layers": 1, "heads": 2, "mlp_dim": 512, "dtype": "float32",
+    })
+    ids = jnp.asarray(np.random.RandomState(6).randint(1, 128, (2, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    fq = quantize_params(fuse_decode_params(params), min_size=1024)
+    kept = dequantize_nonkernel_params(fq, jnp.float32)
+    layer = kept["DecoderLayer_0"]
+    assert is_quantized_leaf(layer["attn"]["qkv"]["kernel"])
+    assert is_quantized_leaf(layer["gate_up"]["kernel"])
+    assert is_quantized_leaf(layer["down"]["kernel"])

@@ -1,0 +1,90 @@
+"""Experiment: where does b8_kv8_int8's roofline gap go? (round-4 #1)
+
+Finding from count analysis: one decode step at B=8/d=2048/L=16 runs
+~4352 quant_matmul GRID STEPS (block 512x512 = 256KB each, ~0.31us of
+DMA) — per-grid-step overhead (~0.5-1us, same disease decode_attention
+cured) explains the ~2.2ms gap.  This experiment A/Bs block shapes at
+the three decode GEMV shapes, in one process, marginal fori_loop
+timing (N=256 vs N=4096, diff/3840), interleaved, median of 7.
+"""
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+from mlcomp_tpu.ops.quant import quantize_leaf
+
+B, D, M = 8, 2048, 8192
+key = jax.random.PRNGKey(0)
+
+
+def qw(d_in, d_out, k):
+    w = jax.random.normal(jax.random.fold_in(key, k), (d_in, d_out), jnp.float32)
+    leaf = quantize_leaf(w)
+    return leaf["q8"], leaf["q8_scale"].reshape(-1)
+
+
+sq, ss = qw(D, D, 1)        # square: q/k/v/out shape
+gu, gus = qw(D, M, 4)       # gate/up shape
+dn, dns = qw(M, D, 6)       # down shape
+
+hd, hds = qw(D, 32768, 7)   # lm_head shape
+
+# name -> (qmat, scale, in_dim, block_n, block_d)
+CASES = {
+    "sq_fatd": (sq, ss, D, 512, 2048),          # 4 steps of 1MB
+    "sq_fatd_b": (sq, ss, D, 512, 2048),        # same again: stability check
+    "gu_n512_fatd": (gu, gus, D, 512, 2048),    # 16 steps of 1MB
+    "gu_n1024_fatd": (gu, gus, D, 1024, 2048),  # 8 steps of 2MB
+    "gu_n2048_fatd": (gu, gus, D, 2048, 2048),  # 4 steps of 4MB
+    "dn_n512_d4096": (dn, dns, M, 512, 4096),   # 8 steps of 2MB
+    "dn_n1024_d4096": (dn, dns, M, 1024, 4096), # 4 steps of 4MB
+    "hd_n1024_fatd": (hd, hds, D, 1024, 2048),  # 32 steps of 2MB
+    "hd_n2048_fatd": (hd, hds, D, 2048, 2048),  # 16 steps of 4MB
+    "hd_512x512": (hd, hds, D, 512, 512),       # today: 256 steps
+}
+
+N_LO, N_HI = 128, 2048
+
+
+def looped(qmat, scale, d_in, bn, bd, n):
+    def body(i, x):
+        y = quant_matmul(x[:, :d_in], qmat, scale, block_n=bn, block_d=bd)
+        # fold output back to a (B, M) carry regardless of out width
+        y = jnp.tile(y[:, :D], (1, M // D))
+        return y * 1e-3
+
+    return jax.jit(
+        lambda x: jax.lax.fori_loop(0, n, body, jnp.tile(x, (1, M // D)))
+    )
+
+
+x0 = jax.random.normal(jax.random.fold_in(key, 99), (B, D), jnp.bfloat16)
+fns = {}
+for name, spec in CASES.items():
+    for n in (N_LO, N_HI):
+        fns[(name, n)] = looped(*spec, n)
+
+print("compiling...", flush=True)
+for (name, n), fn in fns.items():
+    t0 = time.perf_counter()
+    float(fn(x0)[0, 0])
+    print(f"  {name} n={n}: {time.perf_counter()-t0:.1f}s", flush=True)
+
+times = {k: [] for k in fns}
+for w in range(7):
+    for kk, fn in fns.items():
+        t0 = time.perf_counter()
+        float(fn(x0)[0, 0])
+        times[kk].append(time.perf_counter() - t0)
+
+print()
+for name, (qmat, _, _, _, _) in CASES.items():
+    t_lo = statistics.median(times[(name, N_LO)])
+    t_hi = statistics.median(times[(name, N_HI)])
+    per = (t_hi - t_lo) / (N_HI - N_LO) * 1e6
+    roof = qmat.size / 819e9 * 1e6
+    print(f"{name:16s}: {per:8.2f} us/iter  roofline {roof:6.2f} us "
+          f"({roof / per * 100 if per > 0 else 0:5.1f}%)")

@@ -1,0 +1,93 @@
+"""A/B one decoder layer's worth of decode GEMVs: round-3 512x512 blocks
+vs the new _auto_blocks heuristic.  Marginal fori_loop timing, one
+process, interleaved, median of 7."""
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+from mlcomp_tpu.ops.quant import quantize_leaf
+
+B, D, M = 8, 2048, 8192
+key = jax.random.PRNGKey(0)
+
+
+def qw(d_in, d_out, k):
+    w = jax.random.normal(jax.random.fold_in(key, k), (d_in, d_out), jnp.float32)
+    leaf = quantize_leaf(w)
+    return leaf["q8"], leaf["q8_scale"].reshape(-1)
+
+
+wq, wk, wv, wo = (qw(D, D, i) for i in range(4))
+wg, wu = qw(D, M, 4), qw(D, M, 5)
+wd = qw(M, D, 6)
+LAYER_BYTES = 4 * D * D + 3 * D * M
+
+
+def layer(x, bn, bd):
+    def qm(h, w):
+        return quant_matmul(h, w[0], w[1], block_n=bn, block_d=bd)
+
+    a = qm(x, wq) + qm(x, wk) + qm(x, wv)
+    x = x + qm(a * 1e-2, wo)
+    g, u = qm(x, wg), qm(x, wu)
+    return x + qm(jax.nn.silu(g) * u, wd) * 1e-2
+
+
+wqkv = (
+    jnp.concatenate([wq[0], wk[0], wv[0]], axis=1),
+    jnp.concatenate([wq[1], wk[1], wv[1]]),
+)
+wgu = (
+    jnp.concatenate([wg[0], wu[0]], axis=1),
+    jnp.concatenate([wg[1], wu[1]]),
+)
+
+
+def layer_fused(x):
+    qkv = quant_matmul(x, wqkv[0], wqkv[1])
+    a = qkv[:, :D] + qkv[:, D:2 * D] + qkv[:, 2 * D:]
+    x = x + quant_matmul(a * 1e-2, wo[0], wo[1])
+    gu = quant_matmul(x, wgu[0], wgu[1])
+    h = jax.nn.silu(gu[:, :M]) * gu[:, M:]
+    return x + quant_matmul(h, wd[0], wd[1]) * 1e-2
+
+
+VARIANTS = {
+    "old_512x512": lambda x: layer(x, 512, 512),
+    "auto": lambda x: layer(x, None, None),
+    "auto_fused": layer_fused,
+}
+N_LO, N_HI = 64, 1024
+
+
+def looped(f, n):
+    return jax.jit(
+        lambda x: jax.lax.fori_loop(0, n, lambda i, h: f(h) * 1e-1, x)
+    )
+
+
+x0 = jax.random.normal(jax.random.fold_in(key, 99), (B, D), jnp.bfloat16)
+fns = {(nm, n): looped(f, n) for nm, f in VARIANTS.items() for n in (N_LO, N_HI)}
+print("compiling...", flush=True)
+for kk, fn in fns.items():
+    t0 = time.perf_counter()
+    float(fn(x0)[0, 0])
+    print(f"  {kk}: {time.perf_counter()-t0:.1f}s", flush=True)
+
+times = {k: [] for k in fns}
+for w in range(7):
+    for kk, fn in fns.items():
+        t0 = time.perf_counter()
+        float(fn(x0)[0, 0])
+        times[kk].append(time.perf_counter() - t0)
+
+roof = LAYER_BYTES / 819e9 * 1e6
+print(f"\nroofline {roof:.2f} us/layer")
+for nm in VARIANTS:
+    t_lo = statistics.median(times[(nm, N_LO)])
+    t_hi = statistics.median(times[(nm, N_HI)])
+    per = (t_hi - t_lo) / (N_HI - N_LO) * 1e6
+    print(f"{nm:12s}: {per:8.2f} us/layer  ({roof/per*100:5.1f}% of roofline)")
