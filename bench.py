@@ -417,14 +417,15 @@ def bench_decode() -> None:
 
 
 def bench_longctx() -> None:
-    """Long-context single-chip evidence (r2 verdict next#8): a 268M LM
-    (d=1024, L=16) prefills a 16k-token prompt through the flash kernel
-    and decodes against the 16k KV cache.  Off by default
-    (MLCOMP_BENCH_LONGCTX=1 to run): it certifies the long-context story
-    fits and performs on ONE chip; the measured numbers are recorded in
-    SURVEY.md §2.  Prefill time comes from generate(max_new=8); decode
-    ms/tok from the marginal between 72 and 8 new tokens; peak HBM from
-    the runtime's allocator stats."""
+    """Long-context single-chip evidence (r2 verdict next#8, promoted to
+    a DEFAULT line in round 4 so regressions are driver-visible): a
+    268M LM (d=1024, L=16) prefills a 16k-token prompt through the
+    flash kernel and decodes against the 16k KV cache.  Budget guard:
+    it compiles 4 programs of a 268M model (one model compile next to
+    the decode line's fourteen 1.2B ones) and runs LAST; set
+    MLCOMP_BENCH_SKIP_LONGCTX=1 to drop it.  Prefill time comes from
+    generate(max_new=8); decode ms/tok from the marginal between 72 and
+    8 new tokens; peak HBM from the runtime's allocator stats."""
     from functools import partial
 
     from mlcomp_tpu.models import create_model
@@ -596,8 +597,9 @@ def main() -> None:
         bench_scheduler()
     if os.environ.get("MLCOMP_BENCH_SKIP_DECODE", "") not in ("1", "true"):
         bench_decode()
-    if os.environ.get("MLCOMP_BENCH_LONGCTX", "") in ("1", "true"):
-        bench_longctx()  # opt-in: long-context evidence, SURVEY.md §2
+    if os.environ.get("MLCOMP_BENCH_SKIP_LONGCTX", "") not in ("1", "true"):
+        bench_longctx()  # default since r4; last = cheapest to lose to
+        # a bench-budget timeout (the earlier lines are already printed)
 
 
 if __name__ == "__main__":

@@ -184,6 +184,7 @@ def _cmd_pool(args: argparse.Namespace) -> int:
         db_path=args.db,
         base_workdir=args.workdir,
         launch_template=args.launch,
+        kill_template=args.kill,
         heartbeat_timeout_s=args.heartbeat_timeout,
     )
     pool.run_forever(poll_interval=args.poll)
@@ -352,6 +353,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         eos_id=args.eos_id,
         pad_id=args.pad_id,
         quantize=args.quantize or False,
+        batcher=args.batcher,
     )
     if args.warmup:
         n = service.warmup()
@@ -469,6 +471,13 @@ def main(argv=None) -> int:
         " {name} {chips} {workdir} (default: direct exec for localhost,"
         " ssh -o BatchMode=yes for remote hosts)",
     )
+    pl.add_argument(
+        "--kill", default=None,
+        help="kill template override (same placeholders plus {signal}):"
+        " how to reach a wedged daemon on its host — for remote hosts"
+        " the local handle is only the ssh transport, so the default"
+        " remote template pkills the worker by name over a fresh ssh",
+    )
     pl.add_argument("--heartbeat-timeout", type=float, default=30.0)
     pl.add_argument("--poll", type=float, default=2.0)
     pl.set_defaults(fn=_cmd_pool)
@@ -544,8 +553,18 @@ def main(argv=None) -> int:
         " Devices not claimed by named axes fold into dp (e.g."
         " 'tp=4' on 8 chips gives dp=2 tp=4), and every --batch-sizes"
         " entry must divide dp*fsdp — pass 'dp=1,tp=8' to keep small"
-        " batches servable.  Pallas paths (--quantize kernel,"
-        " --kv-quant) are single-chip-only",
+        " batches servable.  --quantize kernel and --kv-quant compose"
+        " with tp/dp meshes (shard_map kernel islands); fsdp does not",
+    )
+    sv.add_argument(
+        "--batcher", default="auto",
+        choices=("auto", "continuous", "window"),
+        help="'continuous' (default off-mesh): fixed decode slots,"
+        " requests join a running decode at the next token step,"
+        " finished rows free their slot, tokens stream (POST"
+        " /generate with \"stream\": true -> SSE).  'window': the"
+        " request-granularity batcher (one generate per arrival"
+        " window; the mesh default)",
     )
     sv.add_argument(
         "--kv-quant", action="store_true",

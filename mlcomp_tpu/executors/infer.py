@@ -95,8 +95,18 @@ class ValidExecutor(Executor):
         cfg = dict(self.args)
         report_cfg = cfg.pop("report", None)
         trainer = _restore_trainer(ctx, cfg, "validating")
+        if report_cfg is not None:
+            from mlcomp_tpu.report.artifacts import publish_layout
+
+            publish_layout(ctx, report_cfg)
         stats = None
-        if report_cfg is not None and report_cfg is not False:
+        # a layout-only report section declares dashboard panels without
+        # asking for a data report (e.g. LM valids, where no
+        # classification/segmentation payload applies)
+        layout_only = (
+            isinstance(report_cfg, dict) and set(report_cfg) == {"layout"}
+        )
+        if report_cfg is not None and report_cfg is not False and not layout_only:
             # reports are auxiliary: never fail a valid task over a
             # malformed report option — fall back to the plain eval pass
             try:

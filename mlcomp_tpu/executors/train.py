@@ -46,6 +46,13 @@ class TrainExecutor(Executor):
         from mlcomp_tpu.train.loop import Trainer
 
         cfg = dict(self.args)
+        # declarative dashboard layout (report/artifacts.py): a train
+        # task's `report: {layout: [...]}` picks its metric panels
+        report_cfg = cfg.pop("report", None)
+        if report_cfg is not None:
+            from mlcomp_tpu.report.artifacts import publish_layout
+
+            publish_layout(ctx, report_cfg)
         storage = ModelStorage(cfg.pop("storage_root", None))
         project = cfg.pop("project", "default")
         # Default storage namespace: dag id + the dag row's creation time.

@@ -262,12 +262,18 @@ def generate(
         # without the barrier XLA re-runs the (cheap-looking) dequant
         # inside every scan iteration, re-reading the int8 AND writing
         # bf16 per token — the barrier pins one materialized copy
-        variables = jax.lax.optimization_barrier(
-            deq(
-                variables,
-                weights_dtype if weights_dtype is not None else jnp.bfloat16,
-            )
+        prepped = deq(
+            variables,
+            weights_dtype if weights_dtype is not None else jnp.bfloat16,
         )
+        if use_quant_kernel:
+            # pre-shape the kernel operands once, outside the token loop
+            # (a 3-D leaf reshaped per call measured as a 12 MB in-loop
+            # relayout copy — see fold_kernel_leaves)
+            from mlcomp_tpu.ops.quant import fold_kernel_leaves
+
+            prepped = fold_kernel_leaves(prepped)
+        variables = jax.lax.optimization_barrier(prepped)
     elif weights_dtype is not None:
         # same eligibility rule as quantize_params: only big matrices.
         # 1D leaves (RMSNorm scales — fp32 by design) and small tensors

@@ -105,6 +105,21 @@ class MoEBlock(nn.Module):
             ).sum(1)                                                 # (T, E)
             toks = tokens.astype(self.dtype)
 
+            if not quantized and t <= 64:
+                # decode-step token counts: keep the expert axis WHOLE
+                # in one einsum — the (E, T, F) intermediate is tiny at
+                # these shapes, and ep-sharded expert weights then
+                # compute their local experts in place with one psum for
+                # the combine (the slice-scan below would instead
+                # all-gather every expert slice under an ep mesh).
+                # Multi-chip MoE serving runs through here.
+                h_all = jax.nn.gelu(jnp.einsum("td,edf->etf", toks, w1))
+                out = jnp.einsum(
+                    "etf,efd,te->td", h_all, w2,
+                    weight.astype(self.dtype),
+                )
+                return out.reshape(b, s, d)
+
             # scan one expert at a time: peak intermediate is (T, d_ff),
             # not (T, E, d_ff) — dense routing must not spike eval memory
             # E× past what a training step uses
@@ -191,7 +206,7 @@ class MoELayer(nn.Module):
     @nn.compact
     def __call__(
         self, x, positions, train: bool = False, decode: bool = False,
-        kv_mask=None,
+        kv_mask=None, cache_cursor=None,
     ):
         from mlcomp_tpu.models.transformer import SelfAttention
 
@@ -199,7 +214,8 @@ class MoELayer(nn.Module):
             self.hidden, self.heads, self.kv_heads, self.dtype,
             seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
             name="attn",
-        )(x, positions, decode=decode, kv_mask=kv_mask)
+        )(x, positions, decode=decode, kv_mask=kv_mask,
+          cache_cursor=cache_cursor)
         h = RMSNorm(self.dtype)(x)
         return x + MoEBlock(
             n_experts=self.n_experts,
@@ -239,10 +255,13 @@ class MoELM(nn.Module):
         decode: bool = False,
         positions=None,
         kv_mask=None,
+        cache_cursor=None,
     ):
         """``decode=True`` runs incremental decoding against the "cache"
         collection (see models/generation.py); the MoE FFN is stateless
-        per token, so only the attention layers carry cache state."""
+        per token, so only the attention layers carry cache state.
+        ``cache_cursor`` (B,) selects per-row write offsets (the
+        continuous-batching engine's contract, transformer.py)."""
         from mlcomp_tpu.models.transformer import resolve_positions
 
         dtype = jnp.dtype(self.dtype)
@@ -258,12 +277,14 @@ class MoELM(nn.Module):
                     self.hidden, self.heads, kv_heads, self.n_experts, d_ff,
                     self.k, self.capacity_factor, dtype,
                     seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
-                )(h, positions, train=train, decode=decode, kv_mask=kv_mask)
+                )(h, positions, train=train, decode=decode, kv_mask=kv_mask,
+                  cache_cursor=cache_cursor)
             else:
                 h = DecoderLayer(
                     self.hidden, self.heads, kv_heads, d_ff, dtype,
                     seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
-                )(h, positions, decode=decode, kv_mask=kv_mask)
+                )(h, positions, decode=decode, kv_mask=kv_mask,
+                  cache_cursor=cache_cursor)
         h = RMSNorm(dtype)(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(h)

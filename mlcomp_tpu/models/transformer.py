@@ -22,6 +22,18 @@ from mlcomp_tpu.models import MODELS
 from mlcomp_tpu.ops.attention import dot_product_attention
 
 
+# trace-time layout knobs for the int8 KV cache's single-token update
+# (see the comment at their use site).  tools/exp_kv_write_ab.py, ONE
+# process, 1.2B b8_kv8_int8, marginal timing: masked-row "where" scale
+# writes beat one-slot DUS by ~0.29 ms/step (2152/2161 vs 2006/1996
+# tok/s); reshape vs transpose for the K/V update is a wash.  Earlier
+# cross-process runs contradicted each other on exactly this choice —
+# only in-process A/Bs count through the tunnel's nondeterministic
+# compile service.
+_KV_UPDATE_RESHAPE = True
+_KV_SCALE_WRITE = "where"
+
+
 def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
     """Rotary embeddings; x: (B, S, H, D), positions: (B, S)."""
     d = x.shape[-1]
@@ -101,7 +113,8 @@ class SelfAttention(nn.Module):
     decode_fused: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, decode=False, kv_mask=None):
+    def __call__(self, x, positions, decode=False, kv_mask=None,
+                 cache_cursor=None):
         d_head = self.hidden // self.heads
         h = RMSNorm(self.dtype)(x)
         if self.decode_fused:
@@ -119,7 +132,7 @@ class SelfAttention(nn.Module):
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
         if decode:
-            attn = self._decode_attention(q, k, v, kv_mask)
+            attn = self._decode_attention(q, k, v, kv_mask, cache_cursor)
             return x + nn.DenseGeneral(
                 self.hidden, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="out"
             )(attn)
@@ -159,7 +172,7 @@ class SelfAttention(nn.Module):
             self.hidden, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="out"
         )(attn)
 
-    def _decode_attention(self, q, k, v, kv_mask):
+    def _decode_attention(self, q, k, v, kv_mask, cache_cursor=None):
         """Incremental attention against a KV cache (autoregressive decode).
 
         The cache buffers are created at init time sized by the init
@@ -171,15 +184,43 @@ class SelfAttention(nn.Module):
 
         ``kv_mask`` (B, max_len) marks cache slots that are valid keys
         (False = left-padding in a ragged prompt batch).
+
+        ``cache_cursor`` (B,) int32 switches to PER-ROW write offsets
+        (single-token steps only): each row writes its K/V at its own
+        slot and attends slots <= its own cursor — the contract the
+        continuous-batching engine (mlcomp_tpu/engine.py) drives, where
+        every row is at a different decode depth.  The module's scalar
+        ``cache_index`` is neither read nor advanced then (the engine
+        owns the cursors).
         """
         if self.kv_quant:
-            return self._decode_attention_quant(q, k, v, kv_mask)
+            return self._decode_attention_quant(
+                q, k, v, kv_mask, cache_cursor
+            )
         b, s, _, _ = q.shape
         cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
+        if cache_cursor is not None:
+            if s != 1:
+                raise ValueError(
+                    "cache_cursor is a single-token-step contract (s=1); "
+                    f"got s={s}"
+                )
+            cur = cache_cursor.astype(jnp.int32)
+            rows = jnp.arange(b)
+            k_all = cached_k.value.at[rows, cur].set(k[:, 0])
+            v_all = cached_v.value.at[rows, cur].set(v[:, 0])
+            cached_k.value = k_all
+            cached_v.value = v_all
+            max_len = k_all.shape[1]
+            slots = jnp.arange(max_len, dtype=jnp.int32)
+            mask = (slots[None, :] <= cur[:, None])[:, None, None]  # (B,1,1,L)
+            if kv_mask is not None:
+                mask = mask & kv_mask[:, None, None, :].astype(jnp.bool_)
+            return dot_product_attention(q, k_all, v_all, mask=mask)
         i = index.value
         k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, i, 0, 0))
         v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, i, 0, 0))
@@ -218,7 +259,7 @@ class SelfAttention(nn.Module):
             )
         return dot_product_attention(q, k_all, v_all, mask=mask)
 
-    def _decode_attention_quant(self, q, k, v, kv_mask):
+    def _decode_attention_quant(self, q, k, v, kv_mask, cache_cursor=None):
         """int8 KV-cache decode (``kv_quant=True``).
 
         Cache layout is (B, Hkv, L, dh) int8 + (B, Hkv, L) f32 scales —
@@ -239,13 +280,17 @@ class SelfAttention(nn.Module):
         """
         from mlcomp_tpu.ops.pallas.decode_attention import (
             decode_attention,
+            pick_buffer_len,
             quantize_kv,
         )
 
         b, s, hkv, dh = k.shape
         dhp = -(-dh // 128) * 128
-        # at init time s == the full buffer length (init_cache contract)
-        lpad = -(-s // 128) * 128
+        # at init time s == the full buffer length (init_cache contract);
+        # the buffer length must leave the flash-decode kernel a FAT
+        # block size (pick_buffer_len) — a plain 128-round can land on
+        # lengths like 2176 = 128 x 17 with no mid-size divisor
+        lpad = pick_buffer_len(s, hkv, dhp)
 
         def zeros(shape, dt):
             return lambda: jnp.zeros(shape, dt)
@@ -275,18 +320,107 @@ class SelfAttention(nn.Module):
             kp, vp = k, v
         kq, ks_ = quantize_kv(kp)
         vq, vs_ = quantize_kv(vp)
-        ckq.value = jax.lax.dynamic_update_slice(
-            ckq.value, kq.transpose(0, 2, 1, 3), (0, 0, i, 0)
-        )
-        cks.value = jax.lax.dynamic_update_slice(
-            cks.value, ks_.transpose(0, 2, 1)[:, :, None], (0, 0, 0, i)
-        )
-        cvq.value = jax.lax.dynamic_update_slice(
-            cvq.value, vq.transpose(0, 2, 1, 3), (0, 0, i, 0)
-        )
-        cvs.value = jax.lax.dynamic_update_slice(
-            cvs.value, vs_.transpose(0, 2, 1)[:, :, None], (0, 0, 0, i)
-        )
+        if cache_cursor is not None:
+            # per-row cursors (engine contract, see _decode_attention):
+            # scatter each row's K/V at its own slot, window per row
+            if s != 1:
+                raise ValueError(
+                    "cache_cursor is a single-token-step contract (s=1); "
+                    f"got s={s}"
+                )
+            cur = cache_cursor.astype(jnp.int32)
+            rows = jnp.arange(b)
+            ckq.value = ckq.value.at[rows, :, cur].set(kq[:, 0])
+            cvq.value = cvq.value.at[rows, :, cur].set(vq[:, 0])
+            hit = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, l_buf), 3)
+                == cur[:, None, None, None]
+            )
+            cks.value = jnp.where(hit, ks_.reshape(b, hkv, 1, 1), cks.value)
+            cvs.value = jnp.where(hit, vs_.reshape(b, hkv, 1, 1), cvs.value)
+            if kv_mask is not None:
+                row_start = jnp.argmax(
+                    kv_mask.astype(jnp.int32), axis=1
+                ).astype(jnp.int32)
+            else:
+                row_start = jnp.zeros((b,), jnp.int32)
+            qp = (
+                jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+                if dhp != dh else q
+            )
+            from mlcomp_tpu.ops.quant import pallas_mesh
+
+            mesh = pallas_mesh()
+            if mesh is not None:
+                from mlcomp_tpu.ops.pallas.decode_attention import (
+                    sharded_decode_attention,
+                )
+
+                out = sharded_decode_attention(
+                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
+                    mesh, kv_start=row_start, kv_stop=cur + 1,
+                    scale=1.0 / (dh**0.5),
+                )
+            else:
+                out = decode_attention(
+                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
+                    kv_start=row_start, kv_stop=cur + 1,
+                    scale=1.0 / (dh**0.5),
+                )
+            return out[..., :dh][:, None]
+        if s == 1:
+            # single-token step (the serving hot path).  Two trace-time
+            # knobs below exist because single-session A/Bs through the
+            # tunnel's nondeterministic compile service were
+            # contradictory — tools/exp_kv_write_ab.py measures all four
+            # combinations in ONE process (memory-note methodology):
+            # reshape vs transpose for the (B,1,H,*)->(B,H,1,*) update
+            # layout, and masked-row where vs one-slot DUS for the f32
+            # scale caches.
+            if _KV_UPDATE_RESHAPE:
+                kq_u, vq_u = (
+                    kq.reshape(b, hkv, 1, dhp), vq.reshape(b, hkv, 1, dhp)
+                )
+            else:
+                kq_u = kq.transpose(0, 2, 1, 3)
+                vq_u = vq.transpose(0, 2, 1, 3)
+            ckq.value = jax.lax.dynamic_update_slice(
+                ckq.value, kq_u, (0, 0, i, 0)
+            )
+            cvq.value = jax.lax.dynamic_update_slice(
+                cvq.value, vq_u, (0, 0, i, 0)
+            )
+            if _KV_SCALE_WRITE == "where":
+                hit = (
+                    jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, l_buf), 3)
+                    == i
+                )
+                cks.value = jnp.where(
+                    hit, ks_.reshape(b, hkv, 1, 1), cks.value
+                )
+                cvs.value = jnp.where(
+                    hit, vs_.reshape(b, hkv, 1, 1), cvs.value
+                )
+            else:
+                cks.value = jax.lax.dynamic_update_slice(
+                    cks.value, ks_.reshape(b, hkv, 1, 1), (0, 0, 0, i)
+                )
+                cvs.value = jax.lax.dynamic_update_slice(
+                    cvs.value, vs_.reshape(b, hkv, 1, 1), (0, 0, 0, i)
+                )
+        else:
+            ckq.value = jax.lax.dynamic_update_slice(
+                ckq.value, kq.transpose(0, 2, 1, 3), (0, 0, i, 0)
+            )
+            cks.value = jax.lax.dynamic_update_slice(
+                cks.value, ks_.transpose(0, 2, 1)[:, :, None], (0, 0, 0, i)
+            )
+            cvq.value = jax.lax.dynamic_update_slice(
+                cvq.value, vq.transpose(0, 2, 1, 3), (0, 0, i, 0)
+            )
+            cvs.value = jax.lax.dynamic_update_slice(
+                cvs.value, vs_.transpose(0, 2, 1)[:, :, None], (0, 0, 0, i)
+            )
         index.value = i + s
 
         if kv_mask is not None:
@@ -301,13 +435,30 @@ class SelfAttention(nn.Module):
                 jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
                 if dhp != dh else q
             )
-            out = decode_attention(
-                qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
-                kv_start=start, kv_stop=i + 1,
-                # softmax scale from the TRUE head dim (q/k were zero-
-                # padded to a lane multiple above)
-                scale=1.0 / (dh**0.5),
-            )
+            from mlcomp_tpu.ops.quant import pallas_mesh
+
+            mesh = pallas_mesh()
+            if mesh is not None:
+                # multi-device serving: run the kernel inside a
+                # shard_map island (heads over tp, batch over dp) —
+                # a bare pallas_call would not partition itself
+                from mlcomp_tpu.ops.pallas.decode_attention import (
+                    sharded_decode_attention,
+                )
+
+                out = sharded_decode_attention(
+                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
+                    mesh, kv_start=start, kv_stop=i + 1,
+                    scale=1.0 / (dh**0.5),
+                )
+            else:
+                out = decode_attention(
+                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
+                    kv_start=start, kv_stop=i + 1,
+                    # softmax scale from the TRUE head dim (q/k were
+                    # zero-padded to a lane multiple above)
+                    scale=1.0 / (dh**0.5),
+                )
             return out[..., :dh][:, None]
 
         def fresh_prefill():
@@ -346,12 +497,14 @@ class DecoderLayer(nn.Module):
     decode_fused: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, decode=False, kv_mask=None):
+    def __call__(self, x, positions, decode=False, kv_mask=None,
+                 cache_cursor=None):
         x = SelfAttention(
             self.hidden, self.heads, self.kv_heads, self.dtype,
             seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
             decode_fused=self.decode_fused, name="attn",
-        )(x, positions, decode=decode, kv_mask=kv_mask)
+        )(x, positions, decode=decode, kv_mask=kv_mask,
+          cache_cursor=cache_cursor)
         h = RMSNorm(self.dtype)(x)
         if self.decode_fused:
             # fused [gate | up] projection: same per-call-overhead
@@ -508,12 +661,15 @@ class TransformerLM(nn.Module):
         decode: bool = False,
         positions=None,
         kv_mask=None,
+        cache_cursor=None,
     ):
         """Forward pass.  ``decode=True`` switches to incremental decoding
         against a mutable "cache" collection (see models/generation.py);
         ``positions`` (required then) carries each token's absolute RoPE
         position, and ``kv_mask`` (B, max_len) masks out invalid
-        (left-pad) cache slots."""
+        (left-pad) cache slots.  ``cache_cursor`` (B,) int32 selects
+        per-row cache write offsets for single-token steps (the
+        continuous-batching engine's contract, see SelfAttention)."""
         dtype = jnp.dtype(self.dtype)
         ids = x.astype(jnp.int32)
         positions = resolve_positions(ids, decode, positions)
@@ -534,7 +690,7 @@ class TransformerLM(nn.Module):
                 seq_parallel=self.seq_parallel, kv_quant=self.kv_quant,
                 decode_fused=self.decode_fused,
                 name=f"DecoderLayer_{i}",
-            )(h, positions, decode, kv_mask)
+            )(h, positions, decode, kv_mask, cache_cursor)
         h = RMSNorm(dtype)(h)
         head = _LMHead(
             self.vocab_size, self.hidden, compute_dtype=self.head_dtype,

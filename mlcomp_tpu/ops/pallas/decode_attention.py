@@ -69,6 +69,42 @@ NEG_INF = -1e30
 LANES = 128
 SUBLANES = 8
 
+# K+V block bytes per grid step, single-buffered: few fat grid steps
+# beat many thin ones (module docstring), but the double-buffered
+# pipeline must fit VMEM beside q and the softmax scratch.  Measured at
+# the serving shape (B=8, Hkv=16, dh=128, l_buf=2304): blk 768 (3
+# steps/row) = 81.5% of the live-window roofline vs 74.3% for 256
+# (9 steps); 1152 regresses (buffer pressure).
+KV_BLOCK_BUDGET = 3 * 1024 * 1024
+
+
+def auto_block_kv(l_buf: int, h_kv: int, dh: int) -> int:
+    """Largest lane-multiple divisor of ``l_buf`` whose K+V blocks fit
+    :data:`KV_BLOCK_BUDGET` (fallback: one lane)."""
+    return max(
+        (bl for bl in range(LANES, l_buf + 1, LANES)
+         if l_buf % bl == 0 and 2 * h_kv * bl * dh <= KV_BLOCK_BUDGET),
+        default=LANES,
+    )
+
+
+def pick_buffer_len(s: int, h_kv: int, dh: int) -> int:
+    """Cache-buffer length for ``s`` live slots: the smallest lane
+    multiple >= s whose :func:`auto_block_kv` block is fat (>= 512, or
+    the whole buffer for short caches).
+
+    The cache allocator must pick lengths the kernel can tile well: a
+    buffer of 2176 slots (= 128 x 17) has no divisor between 128 and
+    itself, so the kernel degrades to 17 thin grid steps per row —
+    profiled 157 us/call vs 108 at blk 768.  Up to 3 extra padding
+    blocks (beyond the decode cursor: masked AND clamp-skipped, so they
+    cost bytes only at rest) buy a fat-block length."""
+    base = -(-s // LANES) * LANES
+    for cand in range(base, base + 4 * LANES + 1, LANES):
+        if auto_block_kv(cand, h_kv, dh) >= min(512, cand):
+            return cand
+    return -(-base // 512) * 512
+
 
 def quantize_kv(x: jax.Array, eps: float = 1e-8) -> Tuple[jax.Array, jax.Array]:
     """Per-row absmax int8: x (..., dh) -> (int8 values, f32 scales (...))."""
@@ -150,7 +186,7 @@ def decode_attention(
     kv_start: Optional[jax.Array] = None,
     kv_stop: Optional[jax.Array] = None,
     scale: Optional[float] = None,
-    block_kv: int = 512,
+    block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Single-token attention against an int8 KV cache.
@@ -179,16 +215,19 @@ def decode_attention(
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     scale = scale if scale is not None else 1.0 / (dh**0.5)
-    blk = next(
-        (bl for bl in (block_kv, 512, 256, LANES)
-         if bl <= block_kv and bl % LANES == 0 and l_buf % bl == 0),
-        None,
-    )
-    if blk is None:
-        raise ValueError(
-            f"block_kv={block_kv}: need a lane-multiple block (>= {LANES}) "
-            f"dividing the cache length {l_buf}"
+    if block_kv is None:
+        blk = auto_block_kv(l_buf, h_kv, dh)
+    else:
+        blk = next(
+            (bl for bl in (block_kv, 512, 256, LANES)
+             if bl <= block_kv and bl % LANES == 0 and l_buf % bl == 0),
+            None,
         )
+        if blk is None:
+            raise ValueError(
+                f"block_kv={block_kv}: need a lane-multiple block "
+                f"(>= {LANES}) dividing the cache length {l_buf}"
+            )
     nk = l_buf // blk
 
     rep = h // h_kv
@@ -245,3 +284,58 @@ def decode_attention(
         interpret=interpret,
     )(start, stop, qg, k8, ks.astype(jnp.float32), v8, vs.astype(jnp.float32))
     return out[:, :, :rep].reshape(b, h, dh)
+
+
+def sharded_decode_attention(
+    q: jax.Array,
+    k8: jax.Array,
+    ks: jax.Array,
+    v8: jax.Array,
+    vs: jax.Array,
+    mesh,
+    kv_start: Optional[jax.Array] = None,
+    kv_stop: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """:func:`decode_attention` under a device mesh: a shard_map island
+    with heads over ``tp`` and batch over the data axes.
+
+    Attention is independent per (row, kv-head) — GQA groups stay whole
+    because ``tp`` must divide BOTH head counts (each device keeps its
+    query heads next to their shared KV head), so no cross-device math
+    happens at all: the wrapper only pins a layout that matches the
+    tp-sharded q/k/v projections feeding it (serve --mesh --kv-quant).
+    """
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    b, h, dh = q.shape
+    h_kv = k8.shape[1]
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and (h % tp or h_kv % tp):
+        raise ValueError(
+            f"int8 KV decode under tp={tp}: tp must divide both heads "
+            f"({h}) and kv heads ({h_kv}) so GQA groups stay device-local"
+        )
+    dbatch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    rows_ax = ("dp", "fsdp") if b % dbatch == 0 else None
+    head_ax = "tp" if tp > 1 else None
+    l_buf = k8.shape[2]
+    start = (
+        jnp.zeros((b,), jnp.int32) if kv_start is None
+        else kv_start.astype(jnp.int32)
+    )
+    stop = (
+        jnp.full((b,), l_buf, jnp.int32) if kv_stop is None
+        else jnp.broadcast_to(kv_stop, (b,)).astype(jnp.int32)
+    )
+    kv_spec = P(rows_ax, head_ax, None, None)
+    fn = _jax.shard_map(
+        functools.partial(decode_attention, scale=scale),
+        mesh=mesh,
+        in_specs=(P(rows_ax, head_ax, None), kv_spec, kv_spec, kv_spec,
+                  kv_spec, P(rows_ax), P(rows_ax)),
+        out_specs=P(rows_ax, head_ax, None),
+        check_vma=False,
+    )
+    return fn(q, k8, ks, v8, vs, start, stop)

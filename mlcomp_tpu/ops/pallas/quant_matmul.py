@@ -95,6 +95,7 @@ def quant_matmul(
     block_n: int | None = None,
     block_d: int | None = None,
     interpret: bool | None = None,
+    prebroadcast_scale: bool = False,
 ) -> jax.Array:
     """``x @ (q8 * scale)`` with the dequant fused into the kernel.
 
@@ -113,17 +114,29 @@ def quant_matmul(
         auto_n, auto_d = _auto_blocks(b, d, n)
         block_n = auto_n if block_n is None else block_n
         block_d = auto_d if block_d is None else block_d
-    # accept only per-output-channel layouts: (n,) or (1, n).  A scale
-    # that merely has n elements (e.g. a per-input-row (d, 1) on a square
-    # kernel) would silently produce wrong outputs — the kernel assumes
-    # scales commute with the contraction.
-    if scale.shape == (1, n):
-        scale = scale.reshape(n)
-    if scale.shape != (n,):
+    # accept only per-output-channel layouts: (n,) or (1, n) — or, with
+    # ``prebroadcast_scale=True`` (an explicit caller CONTRACT, not a
+    # shape inference: the kernel reads row 0 only, so a genuinely
+    # non-uniform (8, n) array would be silently wrong), the
+    # (SUBLANES, n) tile ops/quant.fold_kernel_leaves prepares, keeping
+    # the tile-shaped broadcast OUT of a decode loop's per-step work.
+    # A scale that merely has n elements (e.g. a per-input-row (d, 1)
+    # on a square kernel) would silently produce wrong outputs — the
+    # kernel assumes scales commute with the contraction.
+    prebroadcast = bool(prebroadcast_scale)
+    if prebroadcast and scale.shape != (SUBLANES, n):
         raise ValueError(
-            f"scale must be per-output-channel, shape ({n},) or (1, {n}); "
-            f"got {scale.shape}"
+            f"prebroadcast_scale needs shape ({SUBLANES}, {n}); got "
+            f"{scale.shape}"
         )
+    if not prebroadcast:
+        if scale.shape == (1, n):
+            scale = scale.reshape(n)
+        if scale.shape != (n,):
+            raise ValueError(
+                f"scale must be per-output-channel, shape ({n},) or "
+                f"(1, {n}); got {scale.shape}"
+            )
     # largest preferred block that divides the dim — the SAME rule
     # kernel_consumable (ops/quant.py) checks against, so anything it
     # admits tiles here (any lane multiple works via the 128 fallback)
@@ -146,7 +159,12 @@ def quant_matmul(
         x = jnp.pad(x, ((0, bp - b), (0, 0)))
     # scale rides as an (8, N) broadcast so its block meets the TPU
     # (8, 128) min tile; row 0 is the real data
-    s2 = jnp.broadcast_to(scale.astype(jnp.float32)[None, :], (SUBLANES, n))
+    if prebroadcast:
+        s2 = scale.astype(jnp.float32)
+    else:
+        s2 = jnp.broadcast_to(
+            scale.astype(jnp.float32)[None, :], (SUBLANES, n)
+        )
 
     kernel = functools.partial(_kernel, out_dtype=x.dtype)
     out = pl.pallas_call(

@@ -242,6 +242,132 @@ def expert_matmul(x, leaf: Dict[str, jax.Array], dtype) -> jax.Array:
     return x.astype(dtype) @ dequantize_leaf(leaf, dtype)
 
 
+def fold_kernel_leaves(params):
+    """Pre-shape the kernel-consumable int8 leaves for the decode loop:
+    3-D attention kernels fold to their 2-D matmul operand and every
+    consumable kernel's scale pre-broadcasts to the (8, n) tile the
+    Pallas kernel reads.
+
+    Why this exists (round-4 profiler capture, v5e, 1.2B decode): the
+    interceptor's per-call ``q.reshape(m, n)`` of a 3-D leaf whose
+    compiler-chosen layout isn't row-major lowered to a 12 MB relayout
+    COPY inside the token loop — 624 us/step, 16% of the step — and the
+    per-call ``broadcast_to`` of each scale added another ~60 us/step.
+    Both are loop-invariant; doing them once here (inside the same jit,
+    before the scan, behind the caller's optimization_barrier) leaves
+    row-major operands the custom calls accept as-is.  Embedding and
+    MoE expert leaves pass through untouched (their consumers gather /
+    slice the original shapes)."""
+    from jax.tree_util import tree_map_with_path
+
+    from mlcomp_tpu.ops.pallas.quant_matmul import SUBLANES
+
+    def visit(path, leaf):
+        if not is_quantized_leaf(leaf):
+            return leaf
+        key = getattr(path[-1], "key", None) if path else None
+        if key != "kernel" or not kernel_consumable(leaf):
+            return leaf
+        q = leaf[_QKEY]
+        if q.ndim == 3 and _attn_reduce_axes(path) is None:
+            return leaf
+        folded = folded_2d(leaf)
+        if folded is None:
+            return leaf
+        _, m, n = folded
+        s = leaf[_SKEY].astype(jnp.float32).reshape(1, n)
+        return {
+            _QKEY: q.reshape(m, n),
+            _SKEY: jnp.broadcast_to(s, (SUBLANES, n)),
+        }
+
+    return tree_map_with_path(visit, params, is_leaf=is_quantized_leaf)
+
+
+# module names whose kernels are Megatron ROW-parallel under tp (the
+# contraction dim carries the tp shards, partial outputs psum together);
+# everything else kernel-consumable is column-parallel (output features
+# carry the shards).  Mirrors parallel/sharding.py's TP_RULES.
+_ROW_PARALLEL_NAMES = ("out", "o", "out_proj", "attn_out", "down",
+                       "mlp_out", "output")
+
+
+def pallas_mesh():
+    """The installed mesh when it actually spans devices, else None —
+    the gate for wrapping Pallas kernels in shard_map (a Pallas call
+    with SPMD-sharded operands does not partition itself)."""
+    from mlcomp_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or math.prod(mesh.shape.values()) == 1:
+        return None
+    return mesh
+
+
+def sharded_quant_matmul(x2, q8, scale, mesh, row_parallel: bool,
+                         prebroadcast_scale: bool = False):
+    """``quant_matmul`` under a device mesh: a shard_map island with the
+    Megatron layout implied by the weight's role.
+
+    Column-parallel (q/k/v/qkv, gate/up/gate_up, lm_head): the weight is
+    (m, n) with n sharded over tp, x replicated on tp — each device runs
+    the Pallas kernel on its (m, n/tp) shard and keeps its output slice.
+    Row-parallel (out, down): m carries the tp shards, each device's
+    output is a partial sum over its contraction slice — psum over tp
+    completes it, exactly the collective XLA inserts for the equivalent
+    sharded ``dot_general``.  Rows ride the data axes when divisible.
+    fsdp-sharded weights are NOT supported here (serve.py refuses that
+    combination); tp=1 meshes degrade to a batch-only island.
+    """
+    import functools
+
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul as _qm
+
+    quant_matmul = functools.partial(
+        _qm, prebroadcast_scale=prebroadcast_scale
+    )
+    tp = mesh.shape.get("tp", 1)
+    dbatch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    rows_ax = ("dp", "fsdp") if x2.shape[0] % dbatch == 0 else None
+    m, n = q8.shape
+    if tp > 1:
+        local = m if row_parallel else n
+        if local % (tp * 128):
+            raise ValueError(
+                f"int8 kernel under tp={tp}: the sharded dim ({local}) "
+                f"must split into lane-tileable {local // tp}-wide shards"
+            )
+
+    def sspec(channel_axis):
+        # scale may be (n,) or the pre-broadcast (8, n): the channel
+        # axis is the last one either way
+        return P(None, channel_axis) if scale.ndim == 2 else P(channel_axis)
+
+    if row_parallel and tp > 1:
+        in_specs = (P(rows_ax, "tp"), P("tp", None), sspec(None))
+        out_specs = P(rows_ax, None)
+
+        def f(xl, wl, sl):
+            # cross-device partial sums in f32 (each device's partial is
+            # one bf16 rounding, like a sharded XLA dot's shards); the
+            # caller casts back, so the extra width costs only a tiny
+            # (rows, n) buffer
+            part = quant_matmul(xl, wl, sl).astype(jnp.float32)
+            return jax.lax.psum(part, "tp").astype(xl.dtype)
+    else:
+        in_specs = (P(rows_ax, None), P(None, "tp"), sspec("tp"))
+        out_specs = P(rows_ax, "tp")
+        f = quant_matmul
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )(x2, q8, scale)
+
+
 def quant_kernel_interception():
     """Flax interception context: while active, ``nn.Dense`` /
     ``nn.DenseGeneral`` / ``nn.Embed`` modules whose parameter is an
@@ -303,22 +429,66 @@ def quant_kernel_interception():
                 q, s = k[_QKEY], k[_SKEY]
                 x = args[0]
                 out_dtype = getattr(mod, "dtype", None) or x.dtype
-                feats = q.shape[nc:]
-                # the scale must be constant along every contracted axis
-                # to commute with the matmul; quantize_params guarantees
-                # this for Dense kernels and named attention projections
-                factorable = (
-                    s.ndim == q.ndim
-                    and all(s.shape[i] == 1 for i in range(nc))
-                    and tuple(s.shape[nc:]) == tuple(feats)
+                # fold_kernel_leaves pre-shapes consumable leaves to the
+                # kernel's exact operands (2-D q8, (8, n) scale); the
+                # module's declared features recover the output shape
+                prefolded = (
+                    q.ndim == 2 and s.ndim == 2
+                    and s.shape[0] == 8 and s.shape[1] == q.shape[1]
                 )
-                m = math.prod(q.shape[:nc])
-                n = math.prod(feats)
+                if prefolded:
+                    m, n = q.shape
+                    feats_attr = getattr(mod, "features", None)
+                    if feats_attr is None:
+                        feats = (n,)
+                    elif isinstance(feats_attr, (tuple, list)):
+                        feats = tuple(int(f) for f in feats_attr)
+                    else:
+                        feats = (int(feats_attr),)
+                    factorable = (
+                        math.prod(feats) == n
+                        and math.prod(x.shape[x.ndim - nc:]) == m
+                    )
+                    if not factorable:
+                        raise ValueError(
+                            f"pre-folded int8 leaf {q.shape} does not fit "
+                            f"{type(mod).__name__}(features={feats_attr}) "
+                            f"contracting {nc} axes of input {x.shape}"
+                        )
+                else:
+                    feats = q.shape[nc:]
+                    # the scale must be constant along every contracted
+                    # axis to commute with the matmul; quantize_params
+                    # guarantees this for Dense kernels and named
+                    # attention projections
+                    factorable = (
+                        s.ndim == q.ndim
+                        and all(s.shape[i] == 1 for i in range(nc))
+                        and tuple(s.shape[nc:]) == tuple(feats)
+                    )
+                    m = math.prod(q.shape[:nc])
+                    n = math.prod(feats)
                 if factorable and m % 128 == 0 and n % 128 == 0:
                     x2 = x.reshape(-1, m).astype(jnp.bfloat16)
-                    out = quant_matmul(
-                        x2, q.reshape(m, n), s.reshape(-1)
-                    ).astype(out_dtype).reshape(*x.shape[: x.ndim - nc], *feats)
+                    sv = s if prefolded else s.reshape(-1)
+                    mesh = pallas_mesh()
+                    if mesh is None:
+                        out2 = quant_matmul(
+                            x2, q.reshape(m, n), sv,
+                            prebroadcast_scale=prefolded,
+                        )
+                    else:
+                        # multi-device: the kernel must run inside a
+                        # shard_map island with this weight's Megatron
+                        # role (serve --mesh + quantize "kernel")
+                        out2 = sharded_quant_matmul(
+                            x2, q.reshape(m, n), sv, mesh,
+                            row_parallel=mod.name in _ROW_PARALLEL_NAMES,
+                            prebroadcast_scale=prefolded,
+                        )
+                    out = out2.astype(out_dtype).reshape(
+                        *x.shape[: x.ndim - nc], *feats
+                    )
                 else:  # odd shape/scale layout: dequantize inline, still correct
                     out = jax.lax.dot_general(
                         x.astype(out_dtype),

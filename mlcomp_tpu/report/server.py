@@ -275,31 +275,35 @@ function perClassTable(rows,cols){
  for(const r of rows)row(t,cols.map(c=>typeof r[c]==='number'&&!Number.isInteger(r[c])
   ?r[c].toFixed(3):r[c]));
  return t}
-function renderReport(div,rep,p){
+function renderReport(div,rep,p,sections){
  // unknown kinds and error bodies must not brick the task-detail view
  if(!p||p.error||(p.kind!=='classification'&&p.kind!=='segmentation'))return;
+ // sections: null = render everything (no layout declared); otherwise a
+ // Set of panel types from the task's "layout" artifact
+ const want=s=>!sections||sections.has(s);
  const h=document.createElement('h2');h.textContent='Report: '+rep.name+' ('+p.kind+')';
  div.appendChild(h);
- const sum=document.createElement('p');
- sum.textContent=p.kind==='segmentation'
-  ?'pixel acc '+p.pixel_accuracy.toFixed(4)+' · mIoU '+p.mean_iou.toFixed(4)+
-   ' · mean dice '+p.mean_dice.toFixed(4)+' · '+p.n_pixels+' px'
-  :'accuracy '+p.accuracy.toFixed(4)+' · mAP '+p.mean_average_precision.toFixed(4)+
-   ' · '+p.n+' samples';
- div.appendChild(sum);
- if(p.pr_curves&&Object.keys(p.pr_curves).length){
+ if(want('summary')){
+  const sum=document.createElement('p');
+  sum.textContent=p.kind==='segmentation'
+   ?'pixel acc '+p.pixel_accuracy.toFixed(4)+' · mIoU '+p.mean_iou.toFixed(4)+
+    ' · mean dice '+p.mean_dice.toFixed(4)+' · '+p.n_pixels+' px'
+   :'accuracy '+p.accuracy.toFixed(4)+' · mAP '+p.mean_average_precision.toFixed(4)+
+    ' · '+p.n+' samples';
+  div.appendChild(sum)}
+ if(want('pr_curves')&&p.pr_curves&&Object.keys(p.pr_curves).length){
   const ch=document.createElement('div');ch.className='charts';
   for(const[name,curve]of Object.entries(p.pr_curves))
    if(curve.length>1)ch.appendChild(lineChart('PR: '+name+
     ' (AP '+(p.average_precision[name]||0).toFixed(3)+')',curve,'recall'));
   div.appendChild(ch)}
- if(p.per_class){div.appendChild(perClassTable(p.per_class,
+ if(want('per_class')&&p.per_class){div.appendChild(perClassTable(p.per_class,
   p.kind==='segmentation'?['name','iou','dice','pixels']
    :['name','precision','recall','f1','support']))}
- if(p.confusion&&p.confusion.length<=64){ // matches artifacts max_confusion
+ if(want('confusion')&&p.confusion&&p.confusion.length<=64){ // matches artifacts max_confusion
   const hh=document.createElement('h3');hh.textContent='Confusion matrix';
   div.appendChild(hh);div.appendChild(confusionTable(p.class_names,p.confusion))}
- if(p.worst&&p.worst.length){
+ if(want('gallery')&&p.worst&&p.worst.length){
   const hh=document.createElement('h3');
   hh.textContent='Most-confident mistakes (gallery)';
   div.appendChild(hh);
@@ -360,19 +364,42 @@ async function showTask(id){
  const names=await J('/api/tasks/'+id+'/metrics');
  const series=await Promise.all(
   names.map(n=>J('/api/tasks/'+id+'/metrics/'+n)));
+ // the task's declared dashboard layout, if any (a "layout" report
+ // artifact written from the YAML report: section): series panels pick
+ // which metric charts render and in what order; section panels pick
+ // which report parts render.  No layout = render everything.
+ const reps=await J('/api/tasks/'+id+'/reports');
+ let layout=null;
+ for(const rep of reps)
+  if(rep.name==='layout'){
+   try{let p=repCache.get(rep.id);
+    if(!p){p=await J('/api/reports/'+rep.id);
+     if(!p.error)repCache.set(rep.id,p)}
+    if(p&&p.kind==='layout')layout=p.panels}
+   catch(e){console.warn('layout fetch failed',e)}}
  const ch=document.getElementById('charts');ch.innerHTML='';
  let out='';
- names.forEach((n,i)=>{const s=series[i];
+ if(layout){
+  for(const panel of layout)
+   if(panel.type==='series')
+    for(const m of panel.metrics){
+     const i=names.indexOf(m);
+     const s=i>=0?series[i]:[];
+     if(s.length>1)ch.appendChild(lineChart(panel.title||m,s))}
+  names.forEach((n,i)=>{const s=series[i];
+   if(s.length)out+='metric '+n+' (last): '+s[s.length-1][1]+'\\n'})}
+ else names.forEach((n,i)=>{const s=series[i];
   if(s.length>1)ch.appendChild(lineChart(n,s));
   if(s.length)out+='metric '+n+' (last): '+s[s.length-1][1]+'\\n'});
- const reps=await J('/api/tasks/'+id+'/reports');
+ const sections=layout?new Set(layout.map(p=>p.type)):null;
  const rdiv=document.getElementById('reports');rdiv.innerHTML='';
  for(const rep of reps)
   try{ // payloads are immutable: fetch each report id once per session
+   if(rep.name==='layout')continue;
    let p=repCache.get(rep.id);
    if(!p){p=await J('/api/reports/'+rep.id);
     if(!p.error)repCache.set(rep.id,p)} // don't pin transient errors
-   renderReport(rdiv,rep,p)}
+   renderReport(rdiv,rep,p,sections)}
   catch(e){console.warn('report render failed',rep.id,e)}
  const logs=await J('/api/tasks/'+id+'/logs');
  for(const l of logs)out+='['+l.level+'] '+l.message+'\\n';

@@ -46,6 +46,18 @@ LOCAL_TEMPLATE = (
 )
 REMOTE_TEMPLATE = "ssh -o BatchMode=yes {host} " + LOCAL_TEMPLATE
 
+# For REMOTE hosts the local process handle is the ssh TRANSPORT, not
+# the worker: terminating it orphans the remote daemon, which keeps
+# claiming under the same name while its replacement starts (two live
+# same-name claimers violate the store's naming contract).  The kill
+# template runs after the transport dies and must reach the daemon
+# itself.  {signal} is KILL on the wedge path, TERM on drain (pool
+# names are unique per pool, so the -f match is precise).
+REMOTE_KILL_TEMPLATE = (
+    'ssh -o BatchMode=yes {host} pkill "-{signal}" -f --'
+    ' "worker.*--name.{name}"'
+)
+
 
 @dataclass
 class HostSpec:
@@ -104,6 +116,7 @@ class WorkerPool:
         heartbeat_timeout_s: float = 30.0,
         restart_backoff_s: float = 5.0,
         env: Optional[Dict[str, str]] = None,
+        kill_template: Optional[str] = None,
     ):
         if not hosts:
             raise ValueError("pool needs at least one inventory host")
@@ -116,6 +129,7 @@ class WorkerPool:
         self.db_path = os.path.abspath(db_path or store.path)
         self.base_workdir = os.path.abspath(base_workdir)
         self.launch_template = launch_template
+        self.kill_template = kill_template
         self.python = python
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.restart_backoff_s = restart_backoff_s
@@ -138,20 +152,24 @@ class WorkerPool:
 
     # ------------------------------------------------------------ launching
 
+    def _template_vars(self, m: Dict[str, Any]) -> Dict[str, Any]:
+        h: HostSpec = m["spec"]
+        workdir = h.workdir or os.path.join(self.base_workdir, m["name"])
+        return {
+            "host": shlex.quote(h.host),
+            "python": shlex.quote(self.python),
+            "db": shlex.quote(self.db_path),
+            "name": shlex.quote(m["name"]),
+            "chips": h.chips,
+            "workdir": shlex.quote(workdir),
+        }
+
     def _render(self, m: Dict[str, Any]) -> List[str]:
         h: HostSpec = m["spec"]
         template = self.launch_template or (
             LOCAL_TEMPLATE if h.host in LOCAL_HOSTS else REMOTE_TEMPLATE
         )
-        workdir = h.workdir or os.path.join(self.base_workdir, m["name"])
-        return shlex.split(template.format(
-            host=shlex.quote(h.host),
-            python=shlex.quote(self.python),
-            db=shlex.quote(self.db_path),
-            name=shlex.quote(m["name"]),
-            chips=h.chips,
-            workdir=shlex.quote(workdir),
-        ))
+        return shlex.split(template.format(**self._template_vars(m)))
 
     def _launch(self, m: Dict[str, Any]) -> None:
         os.makedirs(self.base_workdir, exist_ok=True)
@@ -176,14 +194,47 @@ class WorkerPool:
 
     def _kill(self, m: Dict[str, Any], grace_s: float = 5.0) -> None:
         proc = m["proc"]
-        if proc is None or proc.poll() is not None:
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        # the local handle may have been only the transport: reach the
+        # actual daemon before any same-name replacement launches
+        self._remote_kill(m, signal_name="KILL")
+
+    def _remote_kill(self, m: Dict[str, Any], signal_name: str) -> None:
+        """Run the kill template against the member's host (no-op for
+        local hosts without an explicit template — their daemon IS the
+        local process)."""
+        h: HostSpec = m["spec"]
+        template = self.kill_template or (
+            None if h.host in LOCAL_HOSTS else REMOTE_KILL_TEMPLATE
+        )
+        if template is None:
             return
-        proc.terminate()
+        cmd = shlex.split(template.format(
+            signal=signal_name, **self._template_vars(m)
+        ))
         try:
-            proc.wait(timeout=grace_s)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
+            res = subprocess.run(
+                cmd, timeout=20.0, capture_output=True,
+            )
+            # pkill exits 1 for "no process matched" — normal when the
+            # daemon already died with its transport
+            if res.returncode not in (0, 1):
+                print(json.dumps({
+                    "event": "pool_remote_kill_failed", "worker": m["name"],
+                    "rc": res.returncode,
+                    "stderr": res.stderr.decode(errors="replace")[-500:],
+                }), flush=True)
+        except (subprocess.TimeoutExpired, OSError) as e:
+            print(json.dumps({
+                "event": "pool_remote_kill_failed", "worker": m["name"],
+                "error": repr(e),
+            }), flush=True)
 
     # ------------------------------------------------------------- watching
 
@@ -255,6 +306,10 @@ class WorkerPool:
         for m in self._members:
             if m["proc"] is not None and m["proc"].poll() is None:
                 m["proc"].terminate()
+            # ssh does not forward SIGTERM to the remote command: ask the
+            # remote daemon to drain too (pkill's default TERM → the
+            # worker's graceful handler)
+            self._remote_kill(m, signal_name="TERM")
         deadline = time.time() + timeout_s
         for m in self._members:
             proc = m["proc"]

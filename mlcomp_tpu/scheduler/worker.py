@@ -125,9 +125,67 @@ def _kill_surviving_child(scratch_dir: str) -> None:
             pass
 
 
+# the kernel's ephemeral (client source) port range floor — coordinator
+# ports must live BELOW it, see _bind_coordinator_socket
+_EPHEMERAL_LO = 32768
+try:
+    with open("/proc/sys/net/ipv4/ip_local_port_range") as _f:
+        _EPHEMERAL_LO = int(_f.read().split()[0])
+except (OSError, ValueError, IndexError):
+    pass
+
+
+def _bind_coordinator_socket() -> socket.socket:
+    """A bound+listening socket on a port OUTSIDE the ephemeral range.
+
+    ``bind(("", 0))`` hands out a port from the kernel's ephemeral pool
+    — the same pool client connections draw SOURCE ports from.  A gang
+    child retry-connecting to such a coordinator port on the same host
+    can be assigned that very port as its source and complete the TCP
+    handshake WITH ITSELF (the classic localhost self-connect): the
+    child then waits forever on a "coordinator" that is its own socket,
+    and the real coordinator can never bind (EADDRINUSE) — exactly the
+    failure the stolen-port gang test caught under load.  Below the
+    ephemeral floor, source-port collisions are impossible."""
+    import random
+    import warnings
+
+    # derive the window from the ACTUAL floor: a host with a widened
+    # ephemeral range (e.g. "1024 65535" in containers) must not get
+    # ports that are secretly inside it
+    hi = _EPHEMERAL_LO
+    lo = max(1024, hi - 16384)
+    if hi - lo < 128:
+        warnings.warn(
+            f"ip_local_port_range floor {_EPHEMERAL_LO} leaves no "
+            "non-ephemeral room for coordinator ports; falling back to "
+            "an ephemeral port — localhost gang peers risk the TCP "
+            "self-connect hang this function exists to prevent"
+        )
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
+        s.listen(1)
+        return s
+    last: Optional[OSError] = None
+    for _ in range(128):
+        port = random.randrange(lo, hi)
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("", port))
+            s.listen(1)
+            return s
+        except OSError as e:
+            last = e
+            s.close()
+    raise RuntimeError(
+        f"no free coordinator port in [{lo}, {hi}) after 128 tries: {last!r}"
+    )
+
+
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("", 0))
+    s = _bind_coordinator_socket()
     port = s.getsockname()[1]
     s.close()
     return port
@@ -658,11 +716,11 @@ class Worker:
             # (_spawn_child_inner); if even that window is lost, the
             # child fails fast (CoordinatorBindError preflight,
             # parallel/distributed.py) and _finalize requeues without
-            # consuming a retry.
-            sock = socket.socket()
-            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            sock.bind(("", 0))
-            sock.listen(1)
+            # consuming a retry.  The port comes from OUTSIDE the
+            # ephemeral range: a peer's retrying connect could otherwise
+            # self-connect to an ephemeral coordinator port and hang
+            # (see _bind_coordinator_socket).
+            sock = _bind_coordinator_socket()
             self.store.publish_coordinator(
                 tid, f"{_host_address()}:{sock.getsockname()[1]}"
             )

@@ -9,11 +9,17 @@ replacing it still needs to SERVE the model they trained.  This daemon
   surface compiles into a small, bounded set of programs (XLA retraces
   nothing at request time; first hit per bucket pays the compile, and
   `--warmup` precompiles the configured buckets at startup);
-- **dynamic micro-batching**: concurrent requests within a small window
-  decode TOGETHER.  Measured on v5e (bench.py decode line, 1.2B): B=8
-  decodes ~3.4× the tokens/s of B=1 — batching is where serving
-  throughput lives, and left-padding + ``prompt_mask`` (generation.py's
-  ragged-prompt contract) makes mixed-length batches exact;
+- **continuous batching** (default, round 4): a fixed pool of decode
+  slots runs one compiled single-token step; a new request prefills
+  alone and JOINS the running decode at the next step boundary,
+  finished rows free their slot immediately, and tokens stream out as
+  they land (``"stream": true`` → SSE).  Batching is where serving
+  throughput lives (measured on v5e, 1.2B: B=8 decodes ~3.4× the
+  tokens/s of B=1) and token-granularity join means a long generation
+  never blocks a later arrival — see mlcomp_tpu/engine.py.  The
+  round-3 WINDOW batcher (requests within a small window decode
+  together through one ``generate`` scan; zero per-token dispatches)
+  remains available as ``batcher="window"`` and is the mesh default;
 - **weight residency**: weights load once, optionally int8-quantized
   with the Pallas kernel consuming them directly (``--quantize kernel``,
   the measured B=1 win) or pre-cast to bf16;
@@ -67,6 +73,17 @@ def _bucket(value: int, buckets: Sequence[int], what: str) -> int:
     )
 
 
+def left_pad_row(ids: Sequence[int], s_bucket: int, pad_id: int):
+    """The serving LEFT-padding contract, in one place (window batcher
+    rows and the continuous engine's prefill share it): returns the
+    (s_bucket,) int32 id row and its bool validity mask."""
+    row = np.full(s_bucket, pad_id, np.int32)
+    mask = np.zeros(s_bucket, bool)
+    row[s_bucket - len(ids):] = ids
+    mask[s_bucket - len(ids):] = True
+    return row, mask
+
+
 class GenerationService:
     """Micro-batching wrapper around ``models.generation.generate``.
 
@@ -92,6 +109,7 @@ class GenerationService:
         seed: int = 0,
         mesh=None,
         repetition_penalty: float = 1.0,
+        batcher: str = "auto",
     ):
         import jax
 
@@ -102,9 +120,10 @@ class GenerationService:
         # mesh config).  Weights arrive already sharded; prompts get the
         # mesh's batch sharding; the KV cache shards by XLA propagation
         # from the tp-sharded K/V projections.  The Pallas paths
-        # (quantize="kernel", model kv_quant) are single-chip-only: the
-        # kernels would need shard_map wrapping — refused below rather
-        # than silently degrading.
+        # (quantize="kernel", model kv_quant) run inside shard_map
+        # islands under the mesh (ops/quant.sharded_quant_matmul,
+        # decode_attention.sharded_decode_attention) — validated here
+        # for the layouts those wrappers support.
         self.mesh = mesh
         if mesh is not None:
             dbatch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
@@ -114,18 +133,26 @@ class GenerationService:
                     f"batch sizes {bad} don't divide the mesh's data axes "
                     f"(dp*fsdp = {dbatch}); fix --batch-sizes"
                 )
-            if getattr(model, "kv_quant", False):
+            pallas = getattr(model, "kv_quant", False) or (
+                str(quantize).strip().lower() == "kernel"
+            )
+            if pallas and mesh.shape.get("fsdp", 1) > 1:
+                # fsdp scatters weights across an axis the kernel
+                # islands don't model; tp is the sharding that matters
+                # for serving big models
                 raise ValueError(
-                    "kv_quant (int8 KV cache) is single-chip for now: the "
-                    "Pallas flash-decode kernel needs shard_map under a "
-                    "mesh; drop --kv-quant or the mesh"
+                    "quantize='kernel' / kv_quant need a tp/dp mesh; "
+                    "fsdp-sharded serving runs bf16 or entry-dequant int8"
                 )
-            if str(quantize).strip().lower() == "kernel":
-                raise ValueError(
-                    "quantize='kernel' is single-chip for now (Pallas "
-                    "under SPMD needs shard_map); use 'int8' (entry "
-                    "dequant) or bf16 with a mesh"
-                )
+            tp = mesh.shape.get("tp", 1)
+            heads = getattr(model, "heads", None)
+            if pallas and tp > 1 and heads:
+                kv_heads = getattr(model, "kv_heads", None) or heads
+                if heads % tp or kv_heads % tp:
+                    raise ValueError(
+                        f"tp={tp} must divide heads ({heads}) and kv_heads "
+                        f"({kv_heads}) for the Pallas serving kernels"
+                    )
         self.batch_sizes = tuple(sorted(batch_sizes))
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.max_new_buckets = tuple(sorted(max_new_buckets))
@@ -171,8 +198,49 @@ class GenerationService:
         self._queue: "queue.Queue" = queue.Queue()
         self._stats = {"requests": 0, "batches": 0, "batched_rows": 0}
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        # batcher selection: "continuous" (default) = token-granularity
+        # slot engine (mlcomp_tpu/engine.py): requests join a running
+        # decode at the next step boundary, finished rows free their
+        # slot, tokens stream as they land.  "window" = the round-3
+        # request-granularity batcher: one generate() per arrival
+        # window — zero per-token dispatches, and the only mode under a
+        # mesh for now (the engine's host-driven step has not been
+        # certified against sharded state).
+        if batcher == "auto":
+            batcher = "window" if mesh is not None else "continuous"
+        if batcher not in ("continuous", "window"):
+            raise ValueError(
+                f"batcher: expected 'auto'/'continuous'/'window', "
+                f"got {batcher!r}"
+            )
+        if batcher == "continuous" and mesh is not None:
+            raise ValueError(
+                "the continuous batcher is single-chip for now; use "
+                "batcher='window' (the default) with a mesh"
+            )
+        self.batcher = batcher
+        if batcher == "continuous":
+            from mlcomp_tpu.engine import DecodeEngine
+
+            self.engine = DecodeEngine(
+                model, self.variables,
+                slots=self.batch_sizes[-1],
+                prompt_buckets=self.prompt_buckets,
+                max_new_cap=self.max_new_buckets[-1],
+                pad_id=self.pad_id,
+                quant_kernel=self.quant_mode == "kernel",
+                seed=seed,
+            )
+            # the engine materialized its own decode-ready tree
+            # (entry-dequant + kernel folding); nothing in continuous
+            # mode reads the original — keeping it pinned would double
+            # weight HBM residency for quantized services
+            self.variables = self.engine.variables
+            self._thread = None
+        else:
+            self.engine = None
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
 
     # ------------------------------------------------------------- public
 
@@ -186,6 +254,7 @@ class GenerationService:
         eos_id: Optional[int] = None,
         logprobs: bool = False,
         repetition_penalty: Optional[float] = None,
+        stream: Optional["queue.Queue"] = None,
     ) -> Future:
         """Enqueue one generation request; resolves to a list of the
         GENERATED ids (prompt excluded, truncated at the request's
@@ -193,7 +262,12 @@ class GenerationService:
 
         Per-request sampling knobs default to the service config; they
         ride the compiled program as per-row arrays, so overriding them
-        costs no recompile and mixed-knob requests batch together."""
+        costs no recompile and mixed-knob requests batch together.
+
+        ``stream`` (continuous batcher only): a ``queue.Queue`` that
+        receives ``{"token", "logprob", "step"}`` dicts as each token
+        lands, then ``None`` — the transport behind the HTTP SSE
+        endpoint."""
         ids = [int(t) for t in prompt_ids]
         if not ids:
             raise ValueError("prompt must be non-empty")
@@ -247,6 +321,17 @@ class GenerationService:
         # request errors, not batcher crashes
         _bucket(len(ids), self.prompt_buckets, "prompt length")
         nb = _bucket(n_new, self.max_new_buckets, "max_new_tokens")
+        self._stats["requests"] += 1
+        if self.engine is not None:
+            return self.engine.submit(
+                ids, n_new, temperature=t, top_k=k, top_p=p, eos_id=eos,
+                logprobs=logprobs, repetition_penalty=rp, stream=stream,
+            )
+        if stream is not None:
+            raise ValueError(
+                "token streaming needs the continuous batcher; this "
+                "service runs the window batcher"
+            )
         fut: Future = Future()
         self._queue.put({
             "ids": ids, "n_new": n_new, "bucket_new": nb, "future": fut,
@@ -257,7 +342,6 @@ class GenerationService:
             "logprobs": bool(logprobs),
             "repetition_penalty": rp,
         })
-        self._stats["requests"] += 1
         return fut
 
     def generate(self, prompt_ids, max_new_tokens, **knobs):
@@ -272,6 +356,17 @@ class GenerationService:
         import jax
         import jax.numpy as jnp
 
+        if self.engine is not None:
+            # one dummy request per prompt bucket compiles that bucket's
+            # prefill; the first compiles the shared insert + step too
+            n_new = min(2, self.engine.max_new_cap)
+            futs = [
+                self.engine.submit([1] * s, n_new)
+                for s in self.prompt_buckets
+            ]
+            for f in futs:
+                f.result(timeout=600)
+            return len(futs)
         n = 0
         s = self.prompt_buckets[-1]
         # smallest + largest SERVABLE batch (1 may not be a bucket
@@ -306,16 +401,25 @@ class GenerationService:
         return n
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             **self._stats,
             "queue_depth": self._queue.qsize(),
             "compiled": sorted(self._fns),
             "quantize": self.quant_mode,
+            "batcher": self.batcher,
         }
+        if self.engine is not None:
+            eng = self.engine.stats()
+            out["queue_depth"] = eng.pop("queue_depth")
+            out["engine"] = eng
+        return out
 
     def close(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=5.0)
+        if self.engine is not None:
+            self.engine.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
         if getattr(self, "_owns_process_mesh", False):
             # load_service installed the mesh process-wide (model code
             # reads current_mesh() for shard_map paths); un-install it so
@@ -434,9 +538,9 @@ class GenerationService:
         prompts = np.full((b_bucket, s_bucket), self.pad_id, np.int32)
         mask = np.zeros((b_bucket, s_bucket), bool)
         for r, item in enumerate(batch):
-            ids = item["ids"]
-            prompts[r, s_bucket - len(ids):] = ids  # LEFT padding
-            mask[r, s_bucket - len(ids):] = True
+            prompts[r], mask[r] = left_pad_row(
+                item["ids"], s_bucket, self.pad_id
+            )
         for r in range(len(batch), b_bucket):
             # filler rows replicate row 0 (never returned); an all-pad
             # row would violate the non-empty-prompt contract
@@ -607,6 +711,44 @@ def serve_http(
                 )
             return self._json({"error": "not found"}, 404)
 
+        def _stream(self, fut, toks: "queue.Queue"):
+            """Server-sent events: one ``data:`` line per token as it
+            lands, a final ``done`` event with the full result, then
+            close (Connection: close bounds the response body).
+
+            Never raises: once the 200/event-stream headers are out, a
+            failure must terminate the STREAM (an ``error`` event), not
+            fall back to do_POST's JSON error path — that would write a
+            second status line into the open body."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                while True:
+                    item = toks.get(timeout=600)
+                    if item is None:
+                        break
+                    self.wfile.write(
+                        f"data: {json.dumps(item)}\n\n".encode()
+                    )
+                    self.wfile.flush()
+                final = fut.result(timeout=600)
+                self.wfile.write(
+                    f"data: {json.dumps({'done': True, **final})}\n\n".encode()
+                )
+                self.wfile.flush()
+            except BrokenPipeError:
+                pass  # client went away; the engine row finishes on its own
+            except Exception as e:
+                err = json.dumps({"error": f"{type(e).__name__}: {e}"})
+                try:
+                    self.wfile.write(f"data: {err}\n\n".encode())
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
         def do_POST(self):  # noqa: N802
             if not self._token_ok():
                 return self._json({"error": "invalid or missing token"}, 403)
@@ -616,6 +758,8 @@ def serve_http(
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 prompt = req["prompt"]
+                want_stream = bool(req.get("stream", False))
+                toks: "queue.Queue" = queue.Queue() if want_stream else None
                 fut = service.submit(
                     prompt, int(req.get("max_new_tokens", 32)),
                     temperature=req.get("temperature"),
@@ -624,7 +768,10 @@ def serve_http(
                     eos_id=req.get("eos_id"),
                     logprobs=req.get("logprobs", False),
                     repetition_penalty=req.get("repetition_penalty"),
+                    stream=toks,
                 )
+                if want_stream:
+                    return self._stream(fut, toks)
                 return self._json(fut.result(timeout=600))
             except (KeyError, ValueError, TypeError) as e:
                 return self._json({"error": f"{type(e).__name__}: {e}"}, 400)

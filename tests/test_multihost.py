@@ -155,7 +155,7 @@ def test_dead_worker_gang_slots_released(store):
 # ------------------------------------------------------------- integration
 
 
-def _run_worker_until(db_path, stop_evt, **kw):
+def _run_worker_until(db_path, stop_evt, errors=None, **kw):
     ws = Store(db_path)
     try:
         w = Worker(ws, isolate=True, load_jax_executors=False,
@@ -163,6 +163,11 @@ def _run_worker_until(db_path, stop_evt, **kw):
         while not stop_evt.is_set():
             if not w.run_once():
                 time.sleep(0.2)
+    except Exception as e:  # a dead worker thread must be VISIBLE in
+        # the test failure, not a silent gang that never fills
+        if errors is not None:
+            errors.append(e)
+        raise
     finally:
         ws.close()
 
@@ -287,7 +292,20 @@ def test_stolen_coordinator_port_gang_recovers(store, tmp_path, monkeypatch):
             thief.setsockopt(
                 socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1
             )
-            thief.bind(("", port))
+            # the slot-1 child may already sit in the closed listener's
+            # un-accepted backlog; until the kernel RSTs that orphaned
+            # pair the port reads EADDRINUSE (SO_REUSEADDR only bypasses
+            # TIME_WAIT) — retry briefly instead of crashing the worker
+            # thread (the deflake: this was the under-load failure mode)
+            deadline = time.time() + 10.0
+            while True:
+                try:
+                    thief.bind(("", port))
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
             thief.listen(1)
             thieves.append(thief)
         return orig(self, claim, gang, ids)
@@ -295,13 +313,15 @@ def test_stolen_coordinator_port_gang_recovers(store, tmp_path, monkeypatch):
     monkeypatch.setattr(Worker, "_spawn_child_inner", stealing_spawn)
     stop_evt = threading.Event()
     threads = []
+    worker_errors: list = []
     for i in range(2):
         wd = tmp_path / f"w{i}"
         wd.mkdir()
         t = threading.Thread(
             target=_run_worker_until,
             args=(store.path, stop_evt),
-            kwargs={"name": f"sp-w{i}", "workdir": str(wd), "chips": 0},
+            kwargs={"name": f"sp-w{i}", "workdir": str(wd), "chips": 0,
+                    "errors": worker_errors},
             daemon=True,
         )
         t.start()
@@ -322,10 +342,14 @@ def test_stolen_coordinator_port_gang_recovers(store, tmp_path, monkeypatch):
             thief.close()
     row = store.task_row(tid)
     logs = "\n".join(l["message"] for l in store.task_logs(tid))
-    assert thieves, "the steal never fired — test harness broken"
-    assert row["status"] == TaskStatus.SUCCESS.value, (
-        f"status={row['status']} error={row['error']}\nlogs:\n{logs}"
+    diag = (
+        f"status={row['status']} retries={row['retries']} "
+        f"error={row['error']}\nworker_thread_errors={worker_errors!r}\n"
+        f"threads_alive={[t.is_alive() for t in threads]}\nlogs:\n{logs}"
     )
+    assert not worker_errors, diag
+    assert thieves, f"the steal never fired\n{diag}"
+    assert row["status"] == TaskStatus.SUCCESS.value, diag
     assert row["retries"] == 0, row["retries"]
     assert "requeued without consuming a retry" in logs, logs
 

@@ -187,3 +187,48 @@ def test_dag_level_metric_comparison(served):
     html = body.decode()
     for needle in ("multiChart", "refreshCompare", "cmpsel", "seriesColor"):
         assert needle in html, needle
+
+
+def test_declared_layout_round_trip(served):
+    """Round 4 (upstream parity): a task's YAML `report: {layout: [...]}`
+    persists as a "layout" artifact the dashboard reads — the API serves
+    it back validated, and the dashboard JS ships the layout-aware
+    rendering path."""
+    from mlcomp_tpu.executors.base import ExecutionContext
+    from mlcomp_tpu.report.artifacts import publish_layout
+
+    store, dag_id, tid, port = served
+    ctx = ExecutionContext(
+        dag_id=dag_id, task_id=tid, task_name="a",
+        args={}, store=store,
+    )
+    assert publish_layout(ctx, {"layout": [
+        {"type": "series", "metrics": ["train/loss"], "title": "Loss"},
+        "confusion",
+        {"type": "gallery"},
+    ]})
+    status, body = _get(port, f"/api/tasks/{tid}/reports")
+    reps = json.loads(body)
+    layout = [r for r in reps if r["name"] == "layout"]
+    assert status == 200 and len(layout) == 1
+    status, body = _get(port, f"/api/reports/{layout[0]['id']}")
+    payload = json.loads(body)
+    assert payload["kind"] == "layout"
+    assert payload["panels"][0] == {
+        "type": "series", "metrics": ["train/loss"], "title": "Loss",
+    }
+    assert payload["panels"][1] == {"type": "confusion"}
+    # the dashboard ships the layout-aware renderer
+    _, html = _get(port, "/")
+    assert b"layout" in html and b"panel.metrics" in html
+
+    # malformed layouts are rejected (logged, not raised) and nothing new
+    # is stored
+    assert not publish_layout(ctx, {"layout": [{"type": "nope"}]})
+    assert not publish_layout(
+        ctx, {"layout": [{"type": "series", "metrics": []}]}
+    )
+    reps2 = json.loads(_get(port, f"/api/tasks/{tid}/reports")[1])
+    assert len([r for r in reps2 if r["name"] == "layout"]) == 1
+    logs = " ".join(l["message"] for l in store.task_logs(tid))
+    assert "layout rejected" in logs

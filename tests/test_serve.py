@@ -47,7 +47,7 @@ def test_serve_matches_direct_generate():
     """A bucketed, left-padded, filler-padded service batch must produce
     exactly what a direct generate on the bare prompt produces (greedy,
     so determinism is total)."""
-    model, svc = _service()
+    model, svc = _service(batcher="window")
     try:
         prompt = [3, 14, 15, 9, 2]  # length 5 -> bucket 8, left-padded
         got = svc.generate(prompt, max_new_tokens=4)
@@ -64,7 +64,7 @@ def test_serve_matches_direct_generate():
 
 def test_serve_batches_concurrent_requests():
     """Concurrent same-bucket requests decode in ONE batch."""
-    model, svc = _service(batch_window_ms=200.0)
+    model, svc = _service(batcher="window", batch_window_ms=200.0)
     try:
         futs = [
             svc.submit([1 + i, 2 + i, 3 + i], max_new_tokens=4)
@@ -87,7 +87,7 @@ def test_serve_batches_concurrent_requests():
 def test_serve_warmup_really_compiles():
     """warmup() must RUN the hot bucket programs (lazy jit means merely
     constructing the wrappers compiles nothing)."""
-    _, svc = _service()
+    _, svc = _service(batcher="window")
     try:
         n = svc.warmup()
         compiled = svc.stats()["compiled"]
@@ -233,7 +233,7 @@ def test_serve_sharded_mesh_matches_unsharded():
         sharded.close()
 
 
-def test_serve_mesh_refuses_pallas_paths_and_bad_batches():
+def test_serve_mesh_validates_pallas_layouts_and_batches():
     from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh
 
     mesh = make_mesh(MeshSpec.from_config({"dp": 2, "tp": 4}))
@@ -243,7 +243,8 @@ def test_serve_mesh_refuses_pallas_paths_and_bad_batches():
     variables = {"params": params, **mstate}
     with pytest.raises(ValueError, match="don't divide"):
         GenerationService(model, variables, mesh=mesh, batch_sizes=(1, 2))
-    with pytest.raises(ValueError, match="single-chip"):
+    # heads=2 cannot split over tp=4 for the Pallas kernel islands
+    with pytest.raises(ValueError, match="must divide heads"):
         GenerationService(
             model, variables, mesh=mesh, batch_sizes=(2,),
             quantize="kernel",
@@ -253,8 +254,42 @@ def test_serve_mesh_refuses_pallas_paths_and_bad_batches():
         "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32",
         "kv_quant": True,
     })
-    with pytest.raises(ValueError, match="single-chip"):
+    with pytest.raises(ValueError, match="must divide heads"):
         GenerationService(kv_model, variables, mesh=mesh, batch_sizes=(2,))
+    fsdp_mesh = make_mesh(MeshSpec.from_config({"fsdp": 4, "tp": 2}))
+    with pytest.raises(ValueError, match="fsdp"):
+        GenerationService(
+            model, variables, mesh=fsdp_mesh, batch_sizes=(4,),
+            quantize="kernel",
+        )
+
+
+def test_serve_sharded_quantized_kernel_matches_single():
+    """Round 4: quantize='kernel' + kv_quant compose with a dp×tp mesh —
+    the Pallas kernels run inside shard_map islands (quant_matmul with
+    Megatron roles, decode_attention with heads over tp) and the greedy
+    tokens match the single-device quantized service."""
+    from mlcomp_tpu.serve import load_service
+
+    # every tp-sharded dim must stay lane-tileable per device: heads*dh
+    # = 256 -> 128/device, mlp 512 -> 256, vocab 256 -> 128
+    cfg = {"name": "transformer_lm", "vocab_size": 256, "hidden": 256,
+           "layers": 2, "heads": 4, "mlp_dim": 512, "dtype": "float32",
+           "kv_quant": True}
+    kw = dict(batch_sizes=(4,), prompt_buckets=(8,), max_new_buckets=(4,),
+              quantize="kernel")
+    plain = load_service(cfg, **kw)
+    try:
+        want = plain.generate([3, 14, 15, 9, 2], max_new_tokens=4)
+    finally:
+        plain.close()
+    sharded = load_service(cfg, mesh_cfg={"dp": 4, "tp": 2}, **kw)
+    try:
+        assert sharded.mesh is not None
+        got = sharded.generate([3, 14, 15, 9, 2], max_new_tokens=4)
+    finally:
+        sharded.close()
+    assert got["ids"] == want["ids"], (got, want)
 
 
 def test_rowwise_sampling_matches_static():
@@ -298,7 +333,7 @@ def test_rowwise_sampling_matches_static():
 def test_serve_per_request_knobs_share_program():
     """Mixed-knob requests batch into ONE compiled program; greedy
     requests keep exact determinism while a sampled row differs."""
-    model, svc = _service(batch_window_ms=4000.0, batch_sizes=(1, 2))
+    model, svc = _service(batcher="window", batch_window_ms=4000.0, batch_sizes=(1, 2))
     try:
         import concurrent.futures as cf
 
@@ -335,7 +370,7 @@ def test_serve_rejects_bad_knobs():
 def test_serve_per_request_eos():
     """A request-level eos_id stops ITS row only; the neutral row runs
     to its full budget — both in one batch/program."""
-    model, svc = _service(batch_window_ms=4000.0, batch_sizes=(1, 2))
+    model, svc = _service(batcher="window", batch_window_ms=4000.0, batch_sizes=(1, 2))
     try:
         # find what greedy emits first so we can use it as the eos
         probe = svc.generate([3, 14, 15, 9, 2], 4)
@@ -387,3 +422,25 @@ def test_serve_repetition_penalty_knob():
             svc.generate([1, 2], 3, repetition_penalty=0.0)
     finally:
         svc.close()
+
+
+def test_serve_moe_sharded_mesh_matches_single():
+    """Round 4: moe_lm serves under a dp×ep mesh (experts sharded at
+    inference through the decode-shape dense einsum) and produces the
+    same greedy tokens as the single-device service."""
+    cfg = {"name": "moe_lm", "vocab_size": 64, "hidden": 32, "layers": 2,
+           "heads": 2, "n_experts": 4, "moe_every": 2, "dtype": "float32"}
+    kw = dict(batch_sizes=(4,), prompt_buckets=(8,), max_new_buckets=(4,))
+    plain = load_service(cfg, **kw)
+    try:
+        want = plain.generate([3, 14, 15, 9, 2], max_new_tokens=4)
+    finally:
+        plain.close()
+    sharded = load_service(cfg, mesh_cfg={"dp": 2, "ep": 4}, **kw)
+    try:
+        w1 = sharded.variables["params"]["MoELayer_0"]["moe"]["experts_w1"]
+        assert "ep" in w1.sharding.spec, w1.sharding.spec
+        got = sharded.generate([3, 14, 15, 9, 2], max_new_tokens=4)
+    finally:
+        sharded.close()
+    assert got["ids"] == want["ids"], (got, want)

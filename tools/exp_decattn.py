@@ -1,0 +1,57 @@
+"""decode_attention block sweep at bench shapes (B=8, Hkv=16, dh=128,
+l_buf=2304): blk=256 (today's largest divisor of 2304) runs 9 grid
+steps/call; blk=768 runs 3.  Marginal fori_loop timing, one process."""
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.ops.pallas.decode_attention import decode_attention
+
+B, HKV, DH, LBUF = 8, 16, 128, 2304
+key = jax.random.PRNGKey(0)
+k8 = jax.random.randint(key, (B, HKV, LBUF, DH), -127, 127, jnp.int8)
+v8 = jax.random.randint(jax.random.fold_in(key, 1), (B, HKV, LBUF, DH), -127, 127, jnp.int8)
+ks = jax.random.uniform(jax.random.fold_in(key, 2), (B, HKV, 1, LBUF), jnp.float32) * 0.01
+vs = jax.random.uniform(jax.random.fold_in(key, 3), (B, HKV, 1, LBUF), jnp.float32) * 0.01
+start = jnp.zeros((B,), jnp.int32)
+stop = jnp.full((B,), 2200, jnp.int32)
+
+CASES = {"blk256": 256, "blk768": 768, "blk1152": 1152}
+N_LO, N_HI = 64, 512
+
+
+def looped(blk, n):
+    def body(i, q):
+        o = decode_attention(q, k8, ks, v8, vs, kv_start=start,
+                             kv_stop=stop, block_kv=blk)
+        return (o * 1e-3 + q * 0.5).astype(q.dtype)
+
+    return jax.jit(lambda q: jax.lax.fori_loop(0, n, body, q))
+
+
+q0 = jax.random.normal(jax.random.fold_in(key, 9), (B, HKV, DH), jnp.bfloat16)
+fns = {}
+for nm, blk in CASES.items():
+    for n in (N_LO, N_HI):
+        fns[(nm, n)] = looped(blk, n)
+for kk, fn in fns.items():
+    t0 = time.perf_counter()
+    float(fn(q0)[0, 0, 0])
+    print(f"  {kk}: {time.perf_counter()-t0:.1f}s", flush=True)
+
+times = {k: [] for k in fns}
+for _ in range(7):
+    for kk, fn in fns.items():
+        t0 = time.perf_counter()
+        float(fn(q0)[0, 0, 0])
+        times[kk].append(time.perf_counter() - t0)
+
+roof = 2 * B * HKV * 2200 * DH / 819e9 * 1e6  # live-window K+V int8 bytes
+print(f"\nlive-window roofline {roof:.1f} us/call")
+for nm in CASES:
+    t_lo = statistics.median(times[(nm, N_LO)])
+    t_hi = statistics.median(times[(nm, N_HI)])
+    per = (t_hi - t_lo) / (N_HI - N_LO) * 1e6
+    print(f"{nm:8s}: {per:8.2f} us/call ({roof/per*100:5.1f}% of live roofline)")
