@@ -155,6 +155,11 @@ class DecodeEngine:
                 f"{self.max_new_cap}"
             )
         self._bucket(len(ids))  # validate now, in the caller thread
+        if self._stop.is_set():
+            # a submit racing close() must fail HERE — after close's
+            # queue drain nobody reads the queue, so an enqueued request
+            # would hold an unresolvable Future
+            raise RuntimeError("decode engine closed")
         if self._broken is not None:
             raise RuntimeError(
                 f"decode engine is down: {self._broken!r}"
@@ -171,6 +176,13 @@ class DecodeEngine:
             "stream": stream,
             "t_submit": time.perf_counter(),
         })
+        if self._stop.is_set() and not fut.done():
+            # close() may have drained the queue between the check above
+            # and our put; resolve the future ourselves (set_exception is
+            # guarded by done() on both sides, so the race is idempotent)
+            if stream is not None:
+                stream.put(None)
+            fut.set_exception(RuntimeError("decode engine closed"))
         self._stats["requests"] += 1
         return fut
 

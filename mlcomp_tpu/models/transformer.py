@@ -320,6 +320,38 @@ class SelfAttention(nn.Module):
             kp, vp = k, v
         kq, ks_ = quantize_kv(kp)
         vq, vs_ = quantize_kv(vp)
+
+        def flash(kv_start, kv_stop):
+            """Single-token flash-decode against the updated buffers,
+            mesh-dispatched (a bare pallas_call would not partition
+            itself under SPMD) — shared by the global-cursor and
+            per-row-cursor (engine) paths.  The softmax scale uses the
+            TRUE head dim (q was zero-padded to a lane multiple)."""
+            from mlcomp_tpu.ops.quant import pallas_mesh
+
+            qp = (
+                jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+                if dhp != dh else q
+            )
+            mesh = pallas_mesh()
+            if mesh is not None:
+                from mlcomp_tpu.ops.pallas.decode_attention import (
+                    sharded_decode_attention,
+                )
+
+                out = sharded_decode_attention(
+                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
+                    mesh, kv_start=kv_start, kv_stop=kv_stop,
+                    scale=1.0 / (dh**0.5),
+                )
+            else:
+                out = decode_attention(
+                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
+                    kv_start=kv_start, kv_stop=kv_stop,
+                    scale=1.0 / (dh**0.5),
+                )
+            return out[..., :dh][:, None]
+
         if cache_cursor is not None:
             # per-row cursors (engine contract, see _decode_attention):
             # scatter each row's K/V at its own slot, window per row
@@ -344,30 +376,7 @@ class SelfAttention(nn.Module):
                 ).astype(jnp.int32)
             else:
                 row_start = jnp.zeros((b,), jnp.int32)
-            qp = (
-                jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
-                if dhp != dh else q
-            )
-            from mlcomp_tpu.ops.quant import pallas_mesh
-
-            mesh = pallas_mesh()
-            if mesh is not None:
-                from mlcomp_tpu.ops.pallas.decode_attention import (
-                    sharded_decode_attention,
-                )
-
-                out = sharded_decode_attention(
-                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
-                    mesh, kv_start=row_start, kv_stop=cur + 1,
-                    scale=1.0 / (dh**0.5),
-                )
-            else:
-                out = decode_attention(
-                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
-                    kv_start=row_start, kv_stop=cur + 1,
-                    scale=1.0 / (dh**0.5),
-                )
-            return out[..., :dh][:, None]
+            return flash(row_start, cur + 1)
         if s == 1:
             # single-token step (the serving hot path).  Two trace-time
             # knobs below exist because single-session A/Bs through the
@@ -431,35 +440,7 @@ class SelfAttention(nn.Module):
             start = jnp.zeros((b,), jnp.int32)
 
         if s == 1:
-            qp = (
-                jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
-                if dhp != dh else q
-            )
-            from mlcomp_tpu.ops.quant import pallas_mesh
-
-            mesh = pallas_mesh()
-            if mesh is not None:
-                # multi-device serving: run the kernel inside a
-                # shard_map island (heads over tp, batch over dp) —
-                # a bare pallas_call would not partition itself
-                from mlcomp_tpu.ops.pallas.decode_attention import (
-                    sharded_decode_attention,
-                )
-
-                out = sharded_decode_attention(
-                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
-                    mesh, kv_start=start, kv_stop=i + 1,
-                    scale=1.0 / (dh**0.5),
-                )
-            else:
-                out = decode_attention(
-                    qp[:, 0], ckq.value, cks.value, cvq.value, cvs.value,
-                    kv_start=start, kv_stop=i + 1,
-                    # softmax scale from the TRUE head dim (q/k were
-                    # zero-padded to a lane multiple above)
-                    scale=1.0 / (dh**0.5),
-                )
-            return out[..., :dh][:, None]
+            return flash(start, i + 1)
 
         def fresh_prefill():
             if kv_mask is None:

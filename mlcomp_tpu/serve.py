@@ -609,6 +609,18 @@ def load_service(
     from mlcomp_tpu.train.optim import create_optimizer
     from mlcomp_tpu.train.state import TrainState, init_model
 
+    model_cfg = dict(model_cfg)
+    # ``decode_fused: true`` changes the PARAM layout (fused qkv/gate_up
+    # serving projections, models/transformer.py) but checkpoints come
+    # from training, which is always unfused: init/restore through the
+    # standard layout, then convert once below.  Mesh serving keeps the
+    # standard layout (the tp sharding rules map per-projection).
+    decode_fused = bool(model_cfg.pop("decode_fused", False))
+    if decode_fused and mesh_cfg:
+        raise ValueError(
+            "decode_fused serving is single-chip (the Megatron tp rules "
+            "shard the unfused projections); drop one of them"
+        )
     model = create_model(dict(model_cfg))
     example = jnp.zeros((1, 8), jnp.int32)
     # a throwaway optimizer only shapes the TrainState container;
@@ -645,8 +657,16 @@ def load_service(
         from mlcomp_tpu.io.checkpoint import restore_eval_state
 
         state = restore_eval_state(ckpt_dir, state)
+    variables = state.eval_variables
+    if decode_fused:
+        from mlcomp_tpu.models.transformer import fuse_decode_params
+
+        model = create_model({**model_cfg, "decode_fused": True})
+        variables = {**variables, "params": fuse_decode_params(
+            variables["params"]
+        )}
     service = GenerationService(
-        model, state.eval_variables, mesh=mesh, **service_kw
+        model, variables, mesh=mesh, **service_kw
     )
     # this service installed the process-wide mesh above; close() resets
     # it (one live mesh-serving GenerationService per process)

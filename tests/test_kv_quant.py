@@ -151,3 +151,29 @@ def test_kv_quant_cache_is_int8():
     kq = cache["DecoderLayer_0"]["attn"]["cached_key_q"]
     assert kq.dtype == jnp.int8
     assert kq.shape[2] % 128 == 0  # lane-rounded buffer
+
+
+def test_buffer_length_picker_prefers_fat_blocks():
+    """pick_buffer_len must never hand the kernel a divisor-free length:
+    2176 = 128*17 would force 17 thin grid steps; the picker pads to the
+    next fat-block length instead (r4 profiler finding)."""
+    from mlcomp_tpu.ops.pallas.decode_attention import (
+        auto_block_kv,
+        pick_buffer_len,
+    )
+
+    # the serve-path shape that regressed: hkv=16, dh=128
+    lpad = pick_buffer_len(2064, 16, 128)
+    blk = auto_block_kv(lpad, 16, 128)
+    assert lpad >= 2064 and lpad % 128 == 0
+    assert blk >= 512, (lpad, blk)
+    # the bench shape keeps its exact length (768 divides 2304)
+    assert pick_buffer_len(2304, 16, 128) == 2304
+    assert auto_block_kv(2304, 16, 128) == 768
+    # short caches keep the whole buffer in one block
+    s = pick_buffer_len(96, 4, 128)
+    assert auto_block_kv(s, 4, 128) == s
+    # budget respected: blocks never exceed ~3MB of K+V
+    for l, h, d in ((16384, 8, 128), (4096, 32, 128), (512, 16, 256)):
+        lp = pick_buffer_len(l, h, d)
+        assert 2 * h * auto_block_kv(lp, h, d) * d <= 3 * 1024 * 1024

@@ -451,3 +451,29 @@ def test_gang_train_executor_two_processes(store, tmp_path):
     # the checkpoint exists on disk
     ckpts = list((tmp_path / "storage").glob("**/checkpoints/*"))
     assert ckpts, "no checkpoint written"
+
+
+def test_coordinator_ports_avoid_ephemeral_range():
+    """r4: gang coordinator ports must come from below the kernel's
+    ephemeral floor — an ephemeral coordinator port can be assigned to a
+    peer's retrying connect as its SOURCE port, completing a TCP
+    self-connect that hangs the gang (the stolen-port test's under-load
+    failure, root-caused this round)."""
+    from mlcomp_tpu.scheduler.worker import (
+        _EPHEMERAL_LO,
+        _bind_coordinator_socket,
+        _free_port,
+    )
+
+    socks = []
+    try:
+        for _ in range(8):
+            s = _bind_coordinator_socket()
+            socks.append(s)
+            assert s.getsockname()[1] < _EPHEMERAL_LO
+        assert _free_port() < _EPHEMERAL_LO
+        # distinct ports even while earlier ones stay held
+        assert len({s.getsockname()[1] for s in socks}) == 8
+    finally:
+        for s in socks:
+            s.close()

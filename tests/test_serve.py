@@ -444,3 +444,41 @@ def test_serve_moe_sharded_mesh_matches_single():
     finally:
         sharded.close()
     assert got["ids"] == want["ids"], (got, want)
+
+
+def test_serve_decode_fused_from_standard_checkpoint(tmp_path):
+    """Round 4: `decode_fused: true` in the serve model config restores a
+    STANDARD (training-layout) checkpoint and converts the params once —
+    greedy tokens equal the unfused service's."""
+    from mlcomp_tpu.io.checkpoint import save_checkpoint
+    from mlcomp_tpu.serve import load_service
+
+    cfg = {"name": "transformer_lm", "vocab_size": 64, "hidden": 64,
+           "layers": 2, "heads": 2, "mlp_dim": 128, "dtype": "float32"}
+    model = create_model(cfg)
+    prompt = jnp.asarray(np.random.RandomState(4).randint(1, 64, (1, 8)))
+    params, mstate = init_model(model, {"x": prompt}, jax.random.PRNGKey(7))
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(
+        ckpt, {"params": params, "model_state": mstate, "step": 1}, step=1
+    )
+    kw = dict(batch_sizes=(1,), prompt_buckets=(8,), max_new_buckets=(4,))
+    plain = load_service(cfg, ckpt_dir=str(ckpt), **kw)
+    try:
+        want = plain.generate([3, 14, 15, 9, 2], max_new_tokens=4)
+    finally:
+        plain.close()
+    fused = load_service(
+        {**cfg, "decode_fused": True}, ckpt_dir=str(ckpt), **kw
+    )
+    try:
+        fparams = fused.variables["params"]
+        assert "qkv" in fparams["DecoderLayer_0"]["attn"]
+        got = fused.generate([3, 14, 15, 9, 2], max_new_tokens=4)
+    finally:
+        fused.close()
+    assert got["ids"] == want["ids"], (got, want)
+    with pytest.raises(ValueError, match="single-chip"):
+        load_service(
+            {**cfg, "decode_fused": True}, mesh_cfg={"dp": 8}, **kw
+        )
