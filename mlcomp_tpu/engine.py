@@ -46,6 +46,17 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 
+def _fail_future(fut: Future, err: Exception) -> None:
+    """Fail a future idempotently: submit's close-race check and close's
+    queue drain can both reach the same future — a bare done()-then-
+    set_exception pair races to InvalidStateError."""
+    try:
+        if not fut.done():
+            fut.set_exception(err)
+    except Exception:  # InvalidStateError: the other side resolved it
+        pass
+
+
 class _Slot:
     __slots__ = (
         "req", "cursor", "position", "start", "remaining", "emitted",
@@ -176,13 +187,14 @@ class DecodeEngine:
             "stream": stream,
             "t_submit": time.perf_counter(),
         })
-        if self._stop.is_set() and not fut.done():
+        if self._stop.is_set():
             # close() may have drained the queue between the check above
-            # and our put; resolve the future ourselves (set_exception is
-            # guarded by done() on both sides, so the race is idempotent)
+            # and our put; resolve the future ourselves (idempotent —
+            # see _fail_future; a duplicate stream None is harmless, the
+            # consumer stops at the first)
             if stream is not None:
                 stream.put(None)
-            fut.set_exception(RuntimeError("decode engine closed"))
+            _fail_future(fut, RuntimeError("decode engine closed"))
         self._stats["requests"] += 1
         return fut
 
@@ -210,8 +222,7 @@ class DecodeEngine:
                 break
             if req["stream"] is not None:
                 req["stream"].put(None)
-            if not req["future"].done():
-                req["future"].set_exception(err)
+            _fail_future(req["future"], err)
 
     # ----------------------------------------------------------- programs
 
@@ -354,8 +365,7 @@ class DecodeEngine:
         if req["stream"] is not None:
             req["stream"].put(None)
         if error is not None:
-            if not req["future"].done():
-                req["future"].set_exception(error)
+            _fail_future(req["future"], error)
             return
         result = {
             "ids": [t for t, _ in sl.emitted],
@@ -427,10 +437,10 @@ class DecodeEngine:
                     continue
                 if req["stream"] is not None:
                     req["stream"].put(None)
-                if not req["future"].done():
-                    req["future"].set_exception(
-                        RuntimeError(f"decode engine is down: {self._broken!r}")
-                    )
+                _fail_future(
+                    req["future"],
+                    RuntimeError(f"decode engine is down: {self._broken!r}"),
+                )
                 continue
             try:
                 # admit as many queued requests as there are free slots —

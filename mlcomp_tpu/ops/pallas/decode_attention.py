@@ -69,13 +69,14 @@ NEG_INF = -1e30
 LANES = 128
 SUBLANES = 8
 
-# K+V block bytes per grid step, single-buffered: few fat grid steps
-# beat many thin ones (module docstring), but the double-buffered
-# pipeline must fit VMEM beside q and the softmax scratch.  Measured at
-# the serving shape (B=8, Hkv=16, dh=128, l_buf=2304): blk 768 (3
-# steps/row) = 81.5% of the live-window roofline vs 74.3% for 256
-# (9 steps); 1152 regresses (buffer pressure).
-KV_BLOCK_BUDGET = 3 * 1024 * 1024
+# K+V block bytes per grid step, single-buffered.  Thin blocks pay
+# per-grid-step overhead (the original finding: blk 256 = 74.3% of the
+# live-window roofline at B=8/Hkv=16/dh=128/l_buf=2304), but VERY fat
+# blocks lose the pipeline's fill/drain amortization: the late round-4
+# sweep measured blk 384 (1.57 MB K+V, 6 steps/row) at 89.5% vs 768
+# (3.1 MB, 3 steps) at 82.0%.  ~2 MB per step is the sweet spot the
+# quant_matmul sweeps found too.
+KV_BLOCK_BUDGET = 2 * 1024 * 1024 + 128 * 1024
 
 
 def auto_block_kv(l_buf: int, h_kv: int, dh: int) -> int:
@@ -90,18 +91,18 @@ def auto_block_kv(l_buf: int, h_kv: int, dh: int) -> int:
 
 def pick_buffer_len(s: int, h_kv: int, dh: int) -> int:
     """Cache-buffer length for ``s`` live slots: the smallest lane
-    multiple >= s whose :func:`auto_block_kv` block is fat (>= 512, or
+    multiple >= s whose :func:`auto_block_kv` block is fat (>= 384, or
     the whole buffer for short caches).
 
     The cache allocator must pick lengths the kernel can tile well: a
     buffer of 2176 slots (= 128 x 17) has no divisor between 128 and
     itself, so the kernel degrades to 17 thin grid steps per row —
-    profiled 157 us/call vs 108 at blk 768.  Up to 3 extra padding
-    blocks (beyond the decode cursor: masked AND clamp-skipped, so they
-    cost bytes only at rest) buy a fat-block length."""
+    profiled 157 us/call vs ~100 at a fat block.  Up to a few extra
+    padding blocks (beyond the decode cursor: masked AND clamp-skipped,
+    so they cost bytes only at rest) buy a fat-block length."""
     base = -(-s // LANES) * LANES
     for cand in range(base, base + 4 * LANES + 1, LANES):
-        if auto_block_kv(cand, h_kv, dh) >= min(512, cand):
+        if auto_block_kv(cand, h_kv, dh) >= min(384, cand):
             return cand
     return -(-base // 512) * 512
 

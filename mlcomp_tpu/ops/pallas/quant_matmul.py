@@ -58,9 +58,6 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, out_dtype):
         o_ref[:] = (acc_ref[:] * s_ref[0:1]).astype(out_dtype)
 
 
-# VMEM budget for one weight block: 4 MB double-buffers inside the
-# ~16 MB/core budget next to x/scale/acc blocks
-_MAX_BLOCK_BYTES = 4 * 1024 * 1024
 _GEMV_ROWS = 64  # row count at or below which the decode heuristic kicks in
 
 
@@ -70,22 +67,24 @@ def _auto_blocks(b: int, d: int, n: int):
     Decode GEMVs (rows <= _GEMV_ROWS) are per-GRID-STEP-overhead bound,
     not bandwidth bound: a (8, 2048)x(2048, 2048) call at the round-3
     512x512 default runs 16 grid steps of 256 KB and measures 9.2 us
-    where the HBM roofline is 5.1 us; the same bytes in 4 fat steps
-    measure 3.3-6.6 us (tools-sweep, v5e, marginal fori_loop timing —
-    the same "few fat grid steps" finding decode_attention.py documents).
-    Aim for ~4 grid steps per call, capped at _MAX_BLOCK_BYTES per
-    weight block: block_d = full D up to 4096, block_n sized so
-    steps_d * steps_n ~= 4.  Larger row counts (prefill interception)
-    keep the measured round-2 512x512 default — there the x/acc blocks
-    share VMEM and bandwidth, and fat weight blocks would evict them.
+    where the HBM roofline is 5.1 us.  The v5e sweeps (tools/exp_*,
+    marginal fori_loop timing, in-process) converge on full-D blocks up
+    to 2048 with ~1-2 MB per block and >= 4 grid steps: (2048, 512)
+    blocks measure 93.7% of the bytes-roofline on the fused gate_up
+    (2048x16384) vs 79.3% for 4 MB blocks, 84.0% on the down-proj
+    (8192x2048, beating both wider-N and deeper-D variants), and the
+    very-wide lm_head (2048x32768) prefers (2048, 1024) at 88.6%.
+    Too-few fat steps lose the pipeline's fill/drain amortization;
+    too-thin steps pay per-step overhead.  Larger row counts (prefill
+    interception) keep the measured round-2 512x512 default — there the
+    x/acc blocks share VMEM and bandwidth, and fat weight blocks would
+    evict them.
     """
     if b > _GEMV_ROWS:
         return 512, 512
-    block_d = min(d, 4096)
-    steps_d = -(-d // block_d)
-    want_n = max(1, 4 // steps_d)
-    block_n = max(LANES, min(n // want_n, _MAX_BLOCK_BYTES // block_d))
-    return block_n, block_d
+    block_d = min(d, 2048)
+    block_n = 512 if n <= 16384 else 1024
+    return min(block_n, n), block_d
 
 
 def quant_matmul(

@@ -1,0 +1,98 @@
+"""Round-4 late sweep: remaining decode-shape block candidates.
+gate_up fused (2048, 16384) and lm_head (2048, 32768) at 2 MB vs 4 MB
+blocks; decode_attention blk 384 vs 768 at l_buf 2304.  One process,
+marginal fori_loop timing, interleaved, median of 7."""
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.ops.pallas.decode_attention import decode_attention
+from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+from mlcomp_tpu.ops.quant import quantize_leaf
+
+B, D = 8, 2048
+key = jax.random.PRNGKey(0)
+
+
+def qw(d_in, d_out, k):
+    w = jax.random.normal(jax.random.fold_in(key, k), (d_in, d_out), jnp.float32)
+    leaf = quantize_leaf(w)
+    return leaf["q8"], leaf["q8_scale"].reshape(-1)
+
+
+gu, gus = qw(D, 16384, 1)
+hd, hds = qw(D, 32768, 2)
+
+HKV, DH, LBUF = 16, 128, 2304
+k8 = jax.random.randint(key, (B, HKV, LBUF, DH), -127, 127, jnp.int8)
+v8 = jax.random.randint(jax.random.fold_in(key, 3), (B, HKV, LBUF, DH), -127, 127, jnp.int8)
+ks = jax.random.uniform(jax.random.fold_in(key, 4), (B, HKV, 1, LBUF)) * 0.01
+vs = jax.random.uniform(jax.random.fold_in(key, 5), (B, HKV, 1, LBUF)) * 0.01
+start = jnp.zeros((B,), jnp.int32)
+stop = jnp.full((B,), 2200, jnp.int32)
+
+
+def mm(w, s, bn, bd):
+    def f(x):
+        y = quant_matmul(x[:, :D], w, s, block_n=bn, block_d=bd)
+        return jnp.tile(y[:, :D] * 1e-3, (1, 1))
+
+    return f, w.size / 819e9 * 1e6
+
+
+def attn(blk):
+    def f(x):
+        q = x[:, :HKV * DH].reshape(B, HKV, DH).astype(jnp.bfloat16)
+        o = decode_attention(q, k8, ks, v8, vs, kv_start=start,
+                             kv_stop=stop, block_kv=blk)
+        return jnp.tile((o.reshape(B, HKV * DH)[:, :D] * 1e-3 + x[:, :D] * .5), (1, 1))
+
+    return f, 2 * HKV * 2200 * DH / 819e9 * 1e6 * 1  # per row? no: per call below
+
+
+CASES = {
+    "gu_n2048_d2048": mm(gu, gus, 2048, 2048),   # 8 steps of 4MB (today)
+    "gu_n1024_d2048": mm(gu, gus, 1024, 2048),   # 16 steps of 2MB
+    "gu_n512_d2048": mm(gu, gus, 512, 2048),     # 32 steps of 1MB
+    "hd_n2048_d2048": mm(hd, hds, 2048, 2048),   # 16 steps of 4MB (today)
+    "hd_n1024_d2048": mm(hd, hds, 1024, 2048),   # 32 steps of 2MB
+    "attn_blk768": attn(768),
+    "attn_blk384": attn(384),
+}
+CASES["attn_blk768"] = (CASES["attn_blk768"][0], 2 * B * HKV * 2200 * DH / 819e9 * 1e6)
+CASES["attn_blk384"] = (CASES["attn_blk384"][0], 2 * B * HKV * 2200 * DH / 819e9 * 1e6)
+
+N_LO, N_HI = 128, 1536
+
+
+def looped(f, n):
+    return jax.jit(lambda x: jax.lax.fori_loop(
+        0, n, lambda i, h: f(h).astype(jnp.bfloat16), x
+    ))
+
+
+x0 = jax.random.normal(jax.random.fold_in(key, 99), (B, D), jnp.bfloat16)
+fns = {}
+for nm, (f, _) in CASES.items():
+    for n in (N_LO, N_HI):
+        fns[(nm, n)] = looped(f, n)
+for kk, fn in fns.items():
+    t0 = time.perf_counter()
+    float(fn(x0)[0, 0])
+    print(f"  {kk}: {time.perf_counter()-t0:.1f}s", flush=True)
+
+times = {k: [] for k in fns}
+for _ in range(7):
+    for kk, fn in fns.items():
+        t0 = time.perf_counter()
+        float(fn(x0)[0, 0])
+        times[kk].append(time.perf_counter() - t0)
+
+for nm, (_, roof) in CASES.items():
+    t_lo = statistics.median(times[(nm, N_LO)])
+    t_hi = statistics.median(times[(nm, N_HI)])
+    per = (t_hi - t_lo) / (N_HI - N_LO) * 1e6
+    print(f"{nm:16s}: {per:8.2f} us/call  roofline {roof:6.1f} "
+          f"({roof/per*100 if per>0 else 0:5.1f}%)")

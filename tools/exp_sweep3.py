@@ -1,0 +1,70 @@
+"""Settle the ~1MB-block hypothesis on the remaining decode shapes:
+lm_head at 1MB and down-proj (8192->2048) at 1/2/4MB."""
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+from mlcomp_tpu.ops.quant import quantize_leaf
+
+B, D, M = 8, 2048, 8192
+key = jax.random.PRNGKey(0)
+
+
+def qw(d_in, d_out, k):
+    w = jax.random.normal(jax.random.fold_in(key, k), (d_in, d_out), jnp.float32)
+    leaf = quantize_leaf(w)
+    return leaf["q8"], leaf["q8_scale"].reshape(-1)
+
+
+hd, hds = qw(D, 32768, 2)
+dn, dns = qw(M, D, 6)
+
+CASES = {
+    "hd_n512_d2048": (hd, hds, D, 512, 2048),    # 1MB, 64 steps
+    "hd_n1024_d2048": (hd, hds, D, 1024, 2048),  # 2MB, 32 steps
+    "dn_n512_d2048": (dn, dns, M, 512, 2048),    # 1MB, 16 steps
+    "dn_n512_d4096": (dn, dns, M, 512, 4096),    # 2MB, 8 steps
+    "dn_n1024_d4096": (dn, dns, M, 1024, 4096),  # 4MB, 4 steps (today)
+}
+N_LO, N_HI = 128, 1536
+
+
+def looped(spec, n):
+    w, s, d_in, bn, bd = spec
+
+    def f(x):
+        y = quant_matmul(
+            jnp.tile(x, (1, d_in // D)), w, s, block_n=bn, block_d=bd
+        )
+        return (y[:, :D] * 1e-3).astype(jnp.bfloat16)
+
+    return jax.jit(lambda x: jax.lax.fori_loop(0, n, lambda i, h: f(h), x))
+
+
+x0 = jax.random.normal(jax.random.fold_in(key, 99), (B, D), jnp.bfloat16)
+fns = {}
+for nm, spec in CASES.items():
+    for n in (N_LO, N_HI):
+        fns[(nm, n)] = looped(spec, n)
+for kk, fn in fns.items():
+    t0 = time.perf_counter()
+    float(fn(x0)[0, 0])
+    print(f"  {kk}: {time.perf_counter()-t0:.1f}s", flush=True)
+
+times = {k: [] for k in fns}
+for _ in range(7):
+    for kk, fn in fns.items():
+        t0 = time.perf_counter()
+        float(fn(x0)[0, 0])
+        times[kk].append(time.perf_counter() - t0)
+
+for nm, spec in CASES.items():
+    t_lo = statistics.median(times[(nm, N_LO)])
+    t_hi = statistics.median(times[(nm, N_HI)])
+    per = (t_hi - t_lo) / (N_HI - N_LO) * 1e6
+    roof = spec[0].size / 819e9 * 1e6
+    print(f"{nm:16s}: {per:8.2f} us/call  roofline {roof:6.1f} "
+          f"({roof/per*100 if per>0 else 0:5.1f}%)")
