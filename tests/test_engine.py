@@ -255,3 +255,34 @@ def test_engine_quant_kernel_matches_generate():
         prompt_mask=jnp.asarray(mask), quant_kernel=True,
     )
     assert got["ids"] == np.asarray(want)[0, 16:].tolist()
+
+
+def test_engine_slot_churn_keeps_outputs_exact():
+    """8 mixed-budget requests through 2 slots: every slot gets reused
+    several times, with different prompts, budgets, eos and penalty
+    knobs — stale state from a previous occupant (cache rows, presence
+    mask, last logits) must never leak into the next one."""
+    model, params = _model_and_params()
+    eng = DecodeEngine(model, {"params": params}, slots=2,
+                       prompt_buckets=(16,), max_new_cap=12)
+    try:
+        rs = np.random.RandomState(9)
+        reqs = []
+        for i in range(8):
+            ids = rs.randint(1, 64, rs.randint(3, 14)).tolist()
+            n_new = int(rs.randint(2, 12))
+            rp = 1.5 if i % 3 == 0 else 1.0
+            reqs.append((ids, n_new, rp, eng.submit(
+                ids, n_new, repetition_penalty=rp,
+            )))
+        for ids, n_new, rp, fut in reqs:
+            got = fut.result(timeout=600)
+            kw = {}
+            if rp != 1.0:
+                kw = {"temperature": jnp.zeros((1,)),
+                      "repetition_penalty": jnp.asarray([rp])}
+            want = _reference(model, params, ids, n_new, **kw)
+            assert got["ids"] == want, (ids, n_new, rp, got["ids"], want)
+        assert eng.stats()["prefills"] == 8
+    finally:
+        eng.close()
