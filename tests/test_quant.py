@@ -409,3 +409,57 @@ def test_fused_qkv_stays_int8_through_nonkernel_dequant():
     assert is_quantized_leaf(layer["attn"]["qkv"]["kernel"])
     assert is_quantized_leaf(layer["gate_up"]["kernel"])
     assert is_quantized_leaf(layer["down"]["kernel"])
+
+
+def test_sharded_quant_matmul_rejects_untileable_tp_shards():
+    """The shard_map island must refuse tp splits that leave non-lane-
+    tileable per-device shards, with the actionable message."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from mlcomp_tpu.ops.quant import quantize_leaf, sharded_quant_matmul
+    from mlcomp_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec.from_config({"dp": 1, "tp": 8}))
+    w = jnp.ones((256, 512), jnp.float32)
+    leaf = quantize_leaf(w)
+    x = jnp.ones((8, 256), jnp.bfloat16)
+    # column-parallel: n=512 over tp=8 -> 64-wide shards, not tileable
+    with _pytest.raises(ValueError, match="lane-tileable"):
+        sharded_quant_matmul(
+            x, leaf["q8"], leaf["q8_scale"].reshape(-1), mesh,
+            row_parallel=False,
+        )
+    # row-parallel: m=256 over tp=8 -> 32-wide shards
+    with _pytest.raises(ValueError, match="lane-tileable"):
+        sharded_quant_matmul(
+            x, leaf["q8"], leaf["q8_scale"].reshape(-1), mesh,
+            row_parallel=True,
+        )
+
+
+def test_quant_matmul_prebroadcast_contract_is_explicit():
+    """(8, n) scales are accepted ONLY under prebroadcast_scale=True (an
+    explicit caller contract — the kernel reads row 0 only, so shape
+    inference would silently accept a genuinely non-uniform array)."""
+    import jax.numpy as jnp
+    import numpy as np_
+    import pytest as _pytest
+
+    from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+    from mlcomp_tpu.ops.quant import quantize_leaf
+
+    rs = np_.random.RandomState(0)
+    w = jnp.asarray(rs.normal(size=(256, 256)), jnp.float32) * 0.05
+    leaf = quantize_leaf(w)
+    x = jnp.asarray(rs.normal(size=(4, 256)), jnp.bfloat16)
+    s1 = leaf["q8_scale"].reshape(-1)
+    s8 = jnp.broadcast_to(s1[None], (8, 256))
+    base = quant_matmul(x, leaf["q8"], s1)
+    pre = quant_matmul(x, leaf["q8"], s8, prebroadcast_scale=True)
+    np_.testing.assert_array_equal(np_.asarray(base), np_.asarray(pre))
+    with _pytest.raises(ValueError, match="per-output-channel"):
+        quant_matmul(x, leaf["q8"], s8)  # no contract, no acceptance
+    with _pytest.raises(ValueError, match="prebroadcast_scale"):
+        quant_matmul(x, leaf["q8"], s1, prebroadcast_scale=True)
