@@ -153,6 +153,7 @@ class DecodeEngine:
         logprobs: bool = False,
         repetition_penalty: float = 1.0,
         stream: Optional["queue.Queue"] = None,
+        _count: bool = True,
     ) -> Future:
         ids = [int(t) for t in prompt_ids]
         if not ids:
@@ -195,7 +196,10 @@ class DecodeEngine:
             if stream is not None:
                 stream.put(None)
             _fail_future(fut, RuntimeError("decode engine closed"))
-        self._stats["requests"] += 1
+        if _count:
+            # warmup's dummy submissions pass _count=False so the
+            # service-visible request count means real requests only
+            self._stats["requests"] += 1
         return fut
 
     def stats(self) -> Dict[str, Any]:

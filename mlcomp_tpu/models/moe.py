@@ -94,9 +94,13 @@ class MoEBlock(nn.Module):
             # same token inside a full forward (T = B*S) would drop
             # differently, so KV-cache generation could diverge from the
             # full forward.  Dense routing (every expert on every token,
-            # top-k combine) restores exact equivalence; at decode shapes
-            # the FFN is tiny, and eval pays e/k× FFN FLOPs for
-            # determinism.
+            # top-k combine) restores ROUTING equivalence; at decode
+            # shapes the FFN is tiny, and eval pays e/k× FFN FLOPs for
+            # determinism.  (Numerically the two dense paths below — the
+            # t<=64 einsum and the per-expert scan — accumulate the
+            # combine in different float orders, so a token decoded one
+            # step at a time agrees with its full-forward value to
+            # dtype tolerance, not bit-exactly; test_moe pins this.)
             topv, topi = jax.lax.top_k(probs, self.k)                # (T, k)
             gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
             weight = (

@@ -364,19 +364,20 @@ async function showTask(id){
  const names=await J('/api/tasks/'+id+'/metrics');
  const series=await Promise.all(
   names.map(n=>J('/api/tasks/'+id+'/metrics/'+n)));
- // the task's declared dashboard layout, if any (a "layout" report
- // artifact written from the YAML report: section): series panels pick
- // which metric charts render and in what order; section panels pick
- // which report parts render.  No layout = render everything.
+ // the task's declared dashboard layout, if any (a report artifact of
+ // KIND 'layout', whatever its name, written from the YAML report:
+ // section): series panels pick which metric charts render and in what
+ // order; section panels pick which report parts render.  No layout =
+ // render everything.  Payloads are immutable, so fetching them all
+ // here costs nothing extra — the render loop below reuses repCache.
  const reps=await J('/api/tasks/'+id+'/reports');
  let layout=null;
  for(const rep of reps)
-  if(rep.name==='layout'){
-   try{let p=repCache.get(rep.id);
-    if(!p){p=await J('/api/reports/'+rep.id);
-     if(!p.error)repCache.set(rep.id,p)}
-    if(p&&p.kind==='layout')layout=p.panels}
-   catch(e){console.warn('layout fetch failed',e)}}
+  try{let p=repCache.get(rep.id);
+   if(!p){p=await J('/api/reports/'+rep.id);
+    if(!p.error)repCache.set(rep.id,p)}
+   if(p&&p.kind==='layout'&&!layout)layout=p.panels}
+  catch(e){console.warn('layout fetch failed',e)}
  const ch=document.getElementById('charts');ch.innerHTML='';
  let out='';
  if(layout){
@@ -395,10 +396,13 @@ async function showTask(id){
  const rdiv=document.getElementById('reports');rdiv.innerHTML='';
  for(const rep of reps)
   try{ // payloads are immutable: fetch each report id once per session
-   if(rep.name==='layout')continue;
    let p=repCache.get(rep.id);
    if(!p){p=await J('/api/reports/'+rep.id);
     if(!p.error)repCache.set(rep.id,p)} // don't pin transient errors
+   // skip LAYOUT payloads (panel config, consumed above — by kind
+   // there too) by their kind, not their name: a user report that
+   // happens to be NAMED 'layout' must still render
+   if(p&&p.kind==='layout')continue;
    renderReport(rdiv,rep,p,sections)}
   catch(e){console.warn('report render failed',rep.id,e)}
  const logs=await J('/api/tasks/'+id+'/logs');

@@ -51,11 +51,23 @@ REMOTE_TEMPLATE = "ssh -o BatchMode=yes {host} " + LOCAL_TEMPLATE
 # claiming under the same name while its replacement starts (two live
 # same-name claimers violate the store's naming contract).  The kill
 # template runs after the transport dies and must reach the daemon
-# itself.  {signal} is KILL on the wedge path, TERM on drain (pool
-# names are unique per pool, so the -f match is precise).
+# itself.  {signal} is KILL on the wedge path, TERM on drain.
+#
+# Pattern details that matter:
+# - ``( |$)`` anchors the name: pool names are unique, but one may be a
+#   PREFIX of another (host-1 vs host-11) and an unanchored match would
+#   SIGKILL the wrong, healthy daemon;
+# - ``--name[ =]`` (a regex class: space or '=', the two separators
+#   argparse accepts, so custom launch templates using ``--name={name}``
+#   stay killable) keeps the pattern from matching the remote shell /
+#   pkill's OWN command line, which contains the pattern text with a
+#   literal '[' — without this, pkill signals its parent shell every
+#   run and ssh reports a spurious failure;
+# - the inner '...' quotes survive the local shlex.split (outer "...")
+#   and reach the remote shell intact, so ( | $ ) are never shell-parsed.
 REMOTE_KILL_TEMPLATE = (
     'ssh -o BatchMode=yes {host} pkill "-{signal}" -f --'
-    ' "worker.*--name.{name}"'
+    ' "\'worker.*--name[ =]{name}( |$)\'"'
 )
 
 

@@ -321,8 +321,10 @@ class GenerationService:
         # request errors, not batcher crashes
         _bucket(len(ids), self.prompt_buckets, "prompt length")
         nb = _bucket(n_new, self.max_new_buckets, "max_new_tokens")
-        self._stats["requests"] += 1
         if self.engine is not None:
+            # the engine counts its own requests (stats() surfaces that
+            # count as the service total) — incrementing here too would
+            # double-count every continuous-mode request
             return self.engine.submit(
                 ids, n_new, temperature=t, top_k=k, top_p=p, eos_id=eos,
                 logprobs=logprobs, repetition_penalty=rp, stream=stream,
@@ -332,6 +334,7 @@ class GenerationService:
                 "token streaming needs the continuous batcher; this "
                 "service runs the window batcher"
             )
+        self._stats["requests"] += 1
         fut: Future = Future()
         self._queue.put({
             "ids": ids, "n_new": n_new, "bucket_new": nb, "future": fut,
@@ -361,7 +364,7 @@ class GenerationService:
             # prefill; the first compiles the shared insert + step too
             n_new = min(2, self.engine.max_new_cap)
             futs = [
-                self.engine.submit([1] * s, n_new)
+                self.engine.submit([1] * s, n_new, _count=False)
                 for s in self.prompt_buckets
             ]
             for f in futs:
@@ -409,8 +412,12 @@ class GenerationService:
             "batcher": self.batcher,
         }
         if self.engine is not None:
+            # the engine is the single counter of continuous-mode
+            # requests (submit() skips the service-level increment, and
+            # warmup's dummy submissions are excluded at the engine)
             eng = self.engine.stats()
             out["queue_depth"] = eng.pop("queue_depth")
+            out["requests"] = eng["requests"]
             out["engine"] = eng
         return out
 

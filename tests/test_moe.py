@@ -73,3 +73,23 @@ def test_moe_lm_trains_with_ep_sharding():
     assert "ep" in w1.sharding.spec, w1.sharding.spec
     stats = tr.train_epoch()
     assert np.isfinite(stats["loss"])
+
+
+def test_moe_dense_einsum_matches_scan_to_tolerance():
+    """r4 advisor (low): the t<=64 dense einsum and the per-expert scan
+    accumulate the combine in different float orders, so a token decoded
+    one step at a time (einsum path) tracks its full-forward value (scan
+    path at t>64) to dtype tolerance — not bit-exactly.  Dense routing
+    is per-token, so the same token in a longer batch routes the same."""
+    block = MoEBlock(n_experts=4, d_model=16, d_ff=32, k=2,
+                     capacity_factor=2.0, dtype=jnp.float32)
+    x_long = jnp.asarray(
+        np.random.RandomState(7).normal(size=(1, 96, 16)), jnp.float32
+    )
+    variables = block.init(jax.random.PRNGKey(0), x_long)
+    out_scan = block.apply(variables, x_long, train=False)       # t=96: scan
+    out_einsum = block.apply(variables, x_long[:, :32], train=False)  # t=32
+    np.testing.assert_allclose(
+        np.asarray(out_einsum), np.asarray(out_scan[:, :32]),
+        rtol=2e-5, atol=2e-5,
+    )

@@ -258,3 +258,45 @@ def test_pool_remote_kill_reaches_detached_daemon(tmp_path, tmp_db):
     while _pid_alive(pid_last) and time.time() < deadline:
         time.sleep(0.05)
     assert not _pid_alive(pid_last)
+
+
+def test_remote_kill_template_pattern_precise_and_self_safe():
+    """r4 advisor (medium): the pkill -f pattern must (a) anchor the
+    worker name — 'host-1' must not SIGKILL 'host-11' — and (b) never
+    match the remote shell / pkill's OWN command line (self-match makes
+    ssh report a spurious nonzero even when the kill worked)."""
+    import re
+    import shlex
+
+    from mlcomp_tpu.scheduler.pool import (
+        LOCAL_TEMPLATE, REMOTE_KILL_TEMPLATE,
+    )
+
+    local_args = shlex.split(REMOTE_KILL_TEMPLATE.format(
+        host="h", signal="KILL", name="host-1",
+    ))
+    # ssh joins the remote words with spaces and hands them to sh -c;
+    # the inner single quotes must survive to keep ( | $ ) shell-safe
+    remote_cmd = " ".join(local_args[4:])
+    remote_args = shlex.split(remote_cmd)  # the remote shell's parse
+    assert remote_args[:3] == ["pkill", "-KILL", "-f"]
+    pattern = remote_args[-1]
+    assert "'" not in pattern  # quotes consumed by the remote shell
+
+    daemon = LOCAL_TEMPLATE.format(
+        python="python", db="/d.sqlite", name="host-1", chips=0,
+        workdir="/w",
+    )
+    other = LOCAL_TEMPLATE.format(
+        python="python", db="/d.sqlite", name="host-11", chips=0,
+        workdir="/w",
+    )
+    assert re.search(pattern, daemon)
+    assert not re.search(pattern, other), "prefix name over-matched"
+    # custom launch templates may render '--name={name}' (argparse
+    # accepts both separators); the default kill pattern must cover it
+    assert re.search(pattern, daemon.replace("--name host-1", "--name=host-1"))
+    # pkill -f matches against full command lines INCLUDING its own and
+    # its parent shell's, both of which contain the pattern text
+    assert not re.search(pattern, remote_cmd), "pattern matched its own cmdline"
+    assert not re.search(pattern, "sh -c " + shlex.quote(remote_cmd))

@@ -482,3 +482,27 @@ def test_serve_decode_fused_from_standard_checkpoint(tmp_path):
         load_service(
             {**cfg, "decode_fused": True}, mesh_cfg={"dp": 8}, **kw
         )
+
+
+def test_serve_request_count_single_sourced():
+    """r4 advisor (low): 'requests' is counted in exactly one place per
+    batcher.  Window mode: the service counts.  Continuous mode: the
+    engine counts (service increment skipped), warmup dummies excluded,
+    and the top-level stats number equals the engine's."""
+    _, svc = _service(batcher="window")
+    try:
+        svc.generate([1, 2, 3], 2)
+        svc.generate([1, 2, 3], 2)
+        assert svc.stats()["requests"] == 2
+    finally:
+        svc.close()
+    _, svc = _service(batcher="continuous")
+    try:
+        svc.warmup()  # dummy submissions must not count
+        assert svc.stats()["requests"] == 0
+        svc.generate([1, 2, 3], 2)
+        st = svc.stats()
+        assert st["requests"] == 1
+        assert st["engine"]["requests"] == 1
+    finally:
+        svc.close()
