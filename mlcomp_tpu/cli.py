@@ -354,6 +354,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         pad_id=args.pad_id,
         quantize=args.quantize or False,
         batcher=args.batcher,
+        steps_per_dispatch=args.steps_per_dispatch,
+        prefill_chunk=args.prefill_chunk,
     )
     if args.warmup:
         n = service.warmup()
@@ -559,12 +561,24 @@ def main(argv=None) -> int:
     sv.add_argument(
         "--batcher", default="auto",
         choices=("auto", "continuous", "window"),
-        help="'continuous' (default off-mesh): fixed decode slots,"
-        " requests join a running decode at the next token step,"
-        " finished rows free their slot, tokens stream (POST"
-        " /generate with \"stream\": true -> SSE).  'window': the"
-        " request-granularity batcher (one generate per arrival"
-        " window; the mesh default)",
+        help="'continuous' (the default, mesh or not): fixed decode"
+        " slots, requests join a running decode at a dispatch"
+        " boundary, finished rows free their slot, tokens stream"
+        " (POST /generate with \"stream\": true -> SSE).  'window':"
+        " the request-granularity batcher (one generate per arrival"
+        " window — offline batch generation)",
+    )
+    sv.add_argument(
+        "--steps-per-dispatch", type=int, default=4,
+        help="continuous batcher: decode steps per compiled dispatch"
+        " (K) — one host dispatch per K tokens; joins land at dispatch"
+        " boundaries, so K bounds the extra join latency",
+    )
+    sv.add_argument(
+        "--prefill-chunk", type=int, default=256,
+        help="continuous batcher: admission prefill chunk (tokens) —"
+        " active rows stall at most one chunk per boundary while a"
+        " joiner prefills; all-pad chunks are skipped",
     )
     sv.add_argument(
         "--kv-quant", action="store_true",

@@ -7,13 +7,16 @@ batcher grouped arrivals, ran one ``generate`` per group, and a
 already in place — per-row KV windows, per-row sampling knobs, static
 bucketed shapes — this module uses them at their natural granularity:
 
-- a fixed pool of ``slots`` decode rows runs ONE compiled single-token
-  step; every step each active row samples, forwards, and streams its
-  token out;
-- a new request PREFILLS alone (one compiled program per prompt
-  bucket, B=1) and its cache rows are INSERTED into a free slot at the
-  next step boundary — arrival-to-first-token is one step, independent
-  of how deep the other rows are in their decodes;
+- a fixed pool of ``slots`` decode rows runs ONE compiled decode
+  program; every inner step each live row samples, forwards, and its
+  token streams out at the next host boundary;
+- a new request PREFILLS in bounded CHUNKS (round 5) interleaved with
+  decode dispatches, and its cache rows are INSERTED into a free slot
+  at a step boundary — the stall any joiner imposes on active rows is
+  one chunk, not a whole prompt-bucket prefill, and all-pad chunks of
+  a short prompt in a big bucket are skipped outright (the cache
+  cursor jumps over them), so admission work scales with the REAL
+  prompt length;
 - finished rows free their slot immediately — no drain barrier, and
   queue order is FIFO over free slots, so the round-3 batcher's
   starvation window (a request re-queued behind an endless stream of
@@ -21,16 +24,34 @@ bucketed shapes — this module uses them at their natural granularity:
 - per-row cache cursors (``cache_cursor``, models/transformer.py) let
   every row sit at a different depth in the shared cache buffers.
 
-TPU-first consequences: shapes never change (slot count, buffer length
-and prompt buckets are static), so the engine compiles `1 + #buckets +
-1` programs total; the step program's carry (cache, logits, presence)
-is donated, so the cache updates stay in-place; sampling knobs ride as
-traced (slots,) arrays — any knob mix shares the one step program.
+TPU-first consequences: shapes never change (slot count, buffer length,
+prompt buckets and the prefill chunk are static), so the engine
+compiles a handful of programs total; the decode program's carry
+(cache, logits, presence) is donated, so the cache updates stay
+in-place; sampling knobs ride as traced (slots,) arrays — any knob mix
+shares the one decode program.
 
-The host drives one dispatch per token step.  On a directly-attached
-TPU that dispatch is tens of microseconds against a multi-ms step; the
-``generate`` scan path (zero dispatches) remains the right tool for
-OFFLINE batch generation, and stays the engine of the window batcher.
+Host dispatch amortization (round 5, r4 verdict missing #1/#4): the
+decode program runs ``steps_per_dispatch`` (K) single-token steps in
+one ``lax.scan`` with per-row early-exit masking, so the host pays ONE
+dispatch + ONE sync per K tokens instead of per token.  A row that
+hits EOS or its budget mid-dispatch stops emitting on device (its
+later inner steps are masked); joins still happen at dispatch
+boundaries, so K bounds the extra join latency at K-1 steps.  K=1
+recovers the round-4 per-token behavior exactly.  ``bench.py``'s
+engine section measures the per-dispatch overhead and the K
+amortization with the in-process A/B methodology (SURVEY §6).
+
+Mesh composition (round 5, r4 verdict missing #2): pass ``mesh`` and
+the engine's prefill/insert/decode programs run as SPMD programs over
+it — weights arrive sharded (Megatron tp layout from the service
+loader), the per-slot KV cache shards by XLA propagation from the
+tp-sharded K/V projections, and the Pallas int8 paths (quant_kernel,
+kv_quant) run inside the same shard_map islands the window batcher
+certified (ops/quant.sharded_quant_matmul,
+decode_attention.sharded_decode_attention — they read the process
+mesh, which ``serve.load_service`` installs).  The host drives the
+same numpy knob rows; under SPMD they replicate.
 
 No upstream analog: the reference framework has no serving path at all.
 """
@@ -44,6 +65,8 @@ from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
+
+_POISON = object()  # close() wakes a blocked queue.get with this
 
 
 def _fail_future(fut: Future, err: Exception) -> None:
@@ -71,15 +94,36 @@ class _Slot:
         self.emitted: List[int] = []
 
 
+class _Admission:
+    """A prefill in progress: one chunk runs per loop boundary, decode
+    dispatches run between chunks (r4 verdict missing #4)."""
+
+    __slots__ = ("req", "s_bucket", "chunk", "n_chunks", "next_chunk",
+                 "row", "positions", "kv_mask", "cache", "last_logits")
+
+    def __init__(self, req, s_bucket, chunk, first_chunk):
+        self.req = req
+        self.s_bucket = s_bucket
+        self.chunk = chunk
+        self.n_chunks = s_bucket // chunk
+        self.next_chunk = first_chunk   # all-pad chunks before are skipped
+        self.row = None                 # (1, s_bucket) ids, set by starter
+        self.positions = None           # (1, s_bucket) host; sliced per chunk
+        self.kv_mask = None             # (1, l_buf) DEVICE; uploaded once
+        self.cache = None               # carried across chunks
+        self.last_logits = None
+
+
 class DecodeEngine:
     """Fixed-slot continuous batcher around a decode-capable model.
 
     ``submit`` returns a Future resolving to the full result dict; pass
     ``stream`` (a ``queue.Queue``) to additionally receive per-token
-    dicts ``{"token", "logprob", "step"}`` as they land, terminated by
-    ``None``.  Greedy outputs are identical to ``generate`` on the same
-    weights: the prefill and per-step math run the same model code, and
-    each row's logits never depend on its neighbours.
+    dicts ``{"token", "logprob", "step"}`` as they land (in bursts of
+    up to ``steps_per_dispatch``), terminated by ``None``.  Greedy
+    outputs are identical to ``generate`` on the same weights: the
+    prefill and per-step math run the same model code, and each row's
+    logits never depend on its neighbours.
     """
 
     def __init__(
@@ -92,6 +136,9 @@ class DecodeEngine:
         pad_id: int = 0,
         quant_kernel: bool = False,
         seed: int = 0,
+        steps_per_dispatch: int = 4,
+        prefill_chunk: int = 256,
+        mesh=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -102,6 +149,13 @@ class DecodeEngine:
         self.max_new_cap = int(max_new_cap)
         self.pad_id = int(pad_id)
         self.quant_kernel = bool(quant_kernel)
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        if self.steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.mesh = mesh
         self.l_buf = self.prompt_buckets[-1] + self.max_new_cap
         self.vocab = int(getattr(model, "vocab_size"))
         self._jax, self._jnp = jax, jnp
@@ -126,14 +180,41 @@ class DecodeEngine:
 
         from mlcomp_tpu.models.generation import init_cache
 
-        self._cache = init_cache(model, self.slots, self.l_buf)
-        self._last_logits = jnp.zeros((self.slots, self.vocab), jnp.float32)
-        self._presence = jnp.zeros((self.slots, self.vocab), jnp.bool_)
-        self._rng = jax.random.PRNGKey(seed)
+        # ALL decode state lives on device and is carried (donated)
+        # through the dispatch/insert programs: a steady-state dispatch
+        # is ONE device call plus ONE packed output fetch — no per-step
+        # knob-row uploads, no host-side rng split.  (Measured through
+        # the tunnel: the round-4 engine's ~10 small host->device
+        # transfers per step cost ~30 ms EACH through the tunnel and a
+        # syscall each even directly-attached; carrying the state cuts
+        # a dispatch to a single call.)  The host keeps a _Slot mirror
+        # purely for bookkeeping (futures, streams, emitted tokens).
+        ns = self.slots
+        self._dstate = {
+            "cache": init_cache(model, ns, self.l_buf),
+            "last_logits": jnp.zeros((ns, self.vocab), jnp.float32),
+            "presence": jnp.zeros((ns, self.vocab), jnp.bool_),
+            "cursors": jnp.zeros((ns,), jnp.int32),
+            "kv_start": jnp.zeros((ns,), jnp.int32),
+            "positions": jnp.zeros((ns,), jnp.int32),
+            "active": jnp.zeros((ns,), jnp.bool_),
+            "remaining": jnp.zeros((ns,), jnp.int32),
+            "eos": jnp.full((ns,), -1, jnp.int32),
+            "t": jnp.zeros((ns,), jnp.float32),
+            "k": jnp.full((ns,), self.vocab, jnp.int32),
+            "p": jnp.ones((ns,), jnp.float32),
+            "rp": jnp.ones((ns,), jnp.float32),
+            "rng": jax.random.PRNGKey(seed),
+        }
         self._host: List[Optional[_Slot]] = [None] * self.slots
+        self._adm: Optional[_Admission] = None
         self._broken: Optional[Exception] = None
+        self._abandoned = False
         self._queue: "queue.Queue" = queue.Queue()
-        self._stats = {"requests": 0, "steps": 0, "prefills": 0}
+        self._stats = {
+            "requests": 0, "steps": 0, "prefills": 0, "dispatches": 0,
+            "prefill_chunks": 0,
+        }
         self.step_count = 0
         self._fns: Dict[Any, Any] = {}
         self._stop = threading.Event()
@@ -209,21 +290,62 @@ class DecodeEngine:
             "queue_depth": self._queue.qsize(),
             "active_slots": active,
             "slots": self.slots,
+            "steps_per_dispatch": self.steps_per_dispatch,
+            "prefill_chunk": self.prefill_chunk,
         }
 
-    def close(self) -> None:
+    def close(self, timeout: Optional[float] = 60.0) -> None:
+        """Stop the step thread, then fail everything still in flight.
+
+        Lifecycle contract (r4 verdict weak #4): shared engine state
+        (slots, cache handles, futures of ACTIVE rows) is mutated only
+        AFTER the step thread has provably exited — the loop is woken
+        with a poison pill and joined.  If the thread does not exit
+        within ``timeout`` (a dispatch wedged in the runtime), the
+        engine is ABANDONED instead: ``_broken`` flips so submits fail
+        fast, queued requests are failed (the queue is thread-safe),
+        but slot/future state the thread may still touch is left alone
+        — no mutate-while-running race, at the cost of active rows'
+        futures resolving only if/when the wedged dispatch returns.
+        """
         self._stop.set()
-        self._thread.join(timeout=10.0)
-        # nobody may be left waiting on a future/stream that will never
-        # resolve: fail in-flight rows and drain the queue
+        self._queue.put(_POISON)  # wake a blocked queue.get NOW
+        self._thread.join(timeout=timeout)
         err = RuntimeError("decode engine closed")
+        if self._thread.is_alive():
+            # wedged mid-dispatch: do NOT touch state the thread owns
+            self._abandoned = True
+            self._broken = RuntimeError(
+                "decode engine close timed out; step thread abandoned"
+            )
+            self._drain_queue(err)
+            return
+        # thread exited: nobody may be left waiting on a future/stream
+        # that will never resolve — fail in-flight rows and the queue
         for i in range(self.slots):
             self._finish(i, error=err)
+        self._fail_admission(err)
+        self._drain_queue(err)
+
+    def _fail_admission(self, err: Exception) -> None:
+        """Terminate the in-flight admission (if any): stream closed,
+        future failed — the one teardown sequence every failure path
+        shares."""
+        if self._adm is None:
+            return
+        adm, self._adm = self._adm, None
+        if adm.req["stream"] is not None:
+            adm.req["stream"].put(None)
+        _fail_future(adm.req["future"], err)
+
+    def _drain_queue(self, err: Exception) -> None:
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
+            if req is _POISON:
+                continue
             if req["stream"] is not None:
                 req["stream"].put(None)
             _fail_future(req["future"], err)
@@ -244,121 +366,266 @@ class DecodeEngine:
                 return self.model.apply(*args, **kwargs)
         return self.model.apply(*args, **kwargs)
 
-    def _prefill_fn(self, s_bucket: int):
-        key = ("prefill", s_bucket)
-        if key not in self._fns:
+    def _prefill_init_fn(self):
+        """Fresh (B=1, l_buf) cache with every layer's cache_index
+        pre-advanced to ``start_slot`` — the skipped all-pad chunks'
+        K/V stay zero and their cache slots are invalid under kv_mask,
+        so jumping the cursor over them is exact."""
+        if "prefill_init" not in self._fns:
             jax, jnp = self._jax, self._jnp
             from mlcomp_tpu.models.generation import init_cache
 
-            def prefill(variables, prompt, mask):
+            def pinit(start_slot):
                 cache = init_cache(self.model, 1, self.l_buf)
-                positions = jnp.maximum(
-                    jnp.cumsum(mask, axis=1) - 1, 0
-                ).astype(jnp.int32)
-                kv_mask = jnp.concatenate(
-                    [mask, jnp.ones((1, self.l_buf - s_bucket), jnp.bool_)],
-                    axis=1,
+                return jax.tree_util.tree_map_with_path(
+                    lambda path, leaf: (
+                        jnp.asarray(start_slot, leaf.dtype)
+                        if path[-1].key == "cache_index" else leaf
+                    ),
+                    cache,
                 )
+
+            self._fns["prefill_init"] = jax.jit(pinit)
+        return self._fns["prefill_init"]
+
+    def _prefill_chunk_fn(self, c: int):
+        """One bounded prefill chunk: (1, c) tokens forward against the
+        carried cache (the model's decode path handles i>0 chunked
+        attention); returns the chunk's last-token logits + the cache.
+        One program per distinct chunk width serves every chunk index
+        and every prompt bucket that width divides."""
+        key = ("prefill_chunk", c)
+        if key not in self._fns:
+            jax, jnp = self._jax, self._jnp
+
+            def pchunk(variables, cache, chunk, positions, kv_mask):
                 logits, upd = self._apply(
-                    {**variables, "cache": cache}, prompt, decode=True,
+                    {**variables, "cache": cache}, chunk, decode=True,
                     positions=positions, kv_mask=kv_mask, mutable=["cache"],
                 )
                 return logits[:, -1].astype(jnp.float32), upd["cache"]
 
-            self._fns[key] = jax.jit(prefill)
+            self._fns[key] = jax.jit(pchunk, donate_argnums=(1,))
         return self._fns[key]
 
     def _insert_fn(self):
-        if "insert" not in self._fns:
-            jax = self._jax
+        """Insert a prefilled row into the device state at a free slot.
 
-            def insert(cache, last_logits, presence, row_cache, row_logits,
-                       row_presence, slot):
-                cache = jax.tree.map(
+        Everything per-slot (cache rows, logits, presence, cursor,
+        position, window start, budget, sampling knobs) lands in ONE
+        donated program; the scalars ride a single packed f32 row
+        (ints < 2^24 round-trip exactly; an eos >= vocab never matches
+        a sampled token, so f32 rounding of a huge eos is harmless)."""
+        if "insert" not in self._fns:
+            jax, jnp = self._jax, self._jnp
+
+            def insert(dstate, row_cache, row_logits, row_presence, packed):
+                slot = packed[0].astype(jnp.int32)
+                out = dict(dstate)
+                out["cache"] = jax.tree.map(
                     lambda ec, rc: ec if rc.ndim == 0
                     else ec.at[slot].set(rc[0]),
-                    cache, row_cache,
+                    dstate["cache"], row_cache,
                 )
-                return (
-                    cache,
-                    last_logits.at[slot].set(row_logits[0]),
-                    presence.at[slot].set(row_presence[0]),
+                out["last_logits"] = dstate["last_logits"].at[slot].set(
+                    row_logits[0]
                 )
+                out["presence"] = dstate["presence"].at[slot].set(
+                    row_presence[0]
+                )
+                for i, (key, dt) in enumerate([
+                    ("cursors", jnp.int32), ("positions", jnp.int32),
+                    ("kv_start", jnp.int32), ("remaining", jnp.int32),
+                    ("eos", jnp.int32), ("t", jnp.float32),
+                    ("k", jnp.int32), ("p", jnp.float32),
+                    ("rp", jnp.float32),
+                ]):
+                    out[key] = dstate[key].at[slot].set(
+                        packed[i + 1].astype(dt)
+                    )
+                out["active"] = dstate["active"].at[slot].set(True)
+                return out
 
-            self._fns["insert"] = jax.jit(insert, donate_argnums=(0, 1, 2))
+            # only dstate donates: the B=1 row buffers have no same-shape
+            # output to reuse (donating them just emits warnings)
+            self._fns["insert"] = jax.jit(insert, donate_argnums=(0,))
         return self._fns["insert"]
 
-    def _step_fn(self):
-        if "step" not in self._fns:
+    def _dispatch_fn(self):
+        """K single-token steps in one lax.scan — one host dispatch and
+        one host sync per K tokens (r4 verdict missing #1).  Per-row
+        early exit: a row whose budget or EOS lands mid-scan stops
+        emitting (``live`` masks its later steps), its cursor freezes so
+        nothing writes past its allocation, and the returned state has
+        it INACTIVE (the device retires rows; the host only does future
+        bookkeeping).  K=1 is exactly the round-4 per-token step.
+
+        Signature is (variables, dstate) -> (dstate', packed): the
+        whole decode state is device-carried and donated, and the K
+        steps' (tokens, logprobs, valid) come back as ONE (3, K, slots)
+        f32 array — a steady-state dispatch moves no per-step operands
+        host->device and fetches one buffer back (token ids < 2^24 are
+        exact in f32)."""
+        if "dispatch" not in self._fns:
             jax, jnp = self._jax, self._jnp
             from mlcomp_tpu.models.generation import sample_token_rowwise
 
-            def step(variables, cache, last_logits, presence, cursors,
-                     kv_start, positions, active, rng, t_row, k_row, p_row,
-                     rp_row):
-                rows = jnp.arange(self.slots)
-                raw = last_logits
+            K = self.steps_per_dispatch
+            rows = jnp.arange(self.slots)
 
-                def penalized():
-                    rp = rp_row[:, None]
-                    return jnp.where(
-                        presence, jnp.where(raw > 0, raw / rp, raw * rp), raw
-                    )
-
-                adj = jax.lax.cond(
-                    jnp.any(rp_row != 1.0), penalized, lambda: raw
-                )
-                tok = sample_token_rowwise(rng, adj, t_row, k_row, p_row)
-                tok = jnp.where(active, tok, jnp.int32(self.pad_id))
-                lp = jnp.take_along_axis(
-                    jax.nn.log_softmax(raw, axis=-1), tok[:, None], axis=-1
-                )[:, 0]
-                presence2 = presence.at[rows, tok].max(active)
+            def dispatch(variables, dstate):
+                kv_start = dstate["kv_start"]
+                eos_row = dstate["eos"]
+                t_row, k_row = dstate["t"], dstate["k"]
+                p_row, rp_row = dstate["p"], dstate["rp"]
                 slots_iota = jnp.arange(self.l_buf, dtype=jnp.int32)
                 kv_mask = slots_iota[None, :] >= kv_start[:, None]
-                logits, upd = self._apply(
-                    {**variables, "cache": cache}, tok[:, None], decode=True,
-                    positions=positions[:, None], kv_mask=kv_mask,
-                    cache_cursor=cursors, mutable=["cache"],
+                # key the penalty machinery on LIVE rows: a finished
+                # slot's stale rp must not keep the (slots, V) penalty
+                # path running for everyone
+                penalty_on = jnp.any((rp_row != 1.0) & dstate["active"])
+
+                def one_step(carry, sub):
+                    (cache, last_logits, presence, cursors, positions,
+                     live, remaining) = carry
+                    raw = last_logits
+
+                    def penalized():
+                        rp = rp_row[:, None]
+                        return jnp.where(
+                            presence,
+                            jnp.where(raw > 0, raw / rp, raw * rp), raw,
+                        )
+
+                    adj = jax.lax.cond(penalty_on, penalized, lambda: raw)
+                    tok = sample_token_rowwise(sub, adj, t_row, k_row, p_row)
+                    tok = jnp.where(live, tok, jnp.int32(self.pad_id))
+                    lp = jnp.take_along_axis(
+                        jax.nn.log_softmax(raw, axis=-1), tok[:, None],
+                        axis=-1,
+                    )[:, 0]
+                    presence = presence.at[rows, tok].max(live)
+                    remaining = jnp.where(live, remaining - 1, remaining)
+                    done_now = live & (
+                        (tok == eos_row) | (remaining <= 0)
+                    )
+                    logits, upd = self._apply(
+                        {**variables, "cache": cache}, tok[:, None],
+                        decode=True, positions=positions[:, None],
+                        kv_mask=kv_mask, cache_cursor=cursors,
+                        mutable=["cache"],
+                    )
+                    carry2 = (
+                        upd["cache"], logits[:, -1].astype(jnp.float32),
+                        presence,
+                        jnp.where(live, cursors + 1, cursors),
+                        jnp.where(live, positions + 1, positions),
+                        live & ~done_now,
+                        remaining,
+                    )
+                    return carry2, (tok, lp, live)
+
+                rng, sub = jax.random.split(dstate["rng"])
+                subs = jax.random.split(sub, K)
+                carry0 = (
+                    dstate["cache"], dstate["last_logits"],
+                    dstate["presence"], dstate["cursors"],
+                    dstate["positions"], dstate["active"],
+                    dstate["remaining"],
                 )
-                return (
-                    upd["cache"], logits[:, -1].astype(jnp.float32),
-                    presence2, tok, lp,
+                carry, (toks, lps, valid) = jax.lax.scan(
+                    one_step, carry0, subs
                 )
+                out = dict(dstate)
+                (out["cache"], out["last_logits"], out["presence"],
+                 out["cursors"], out["positions"], out["active"],
+                 out["remaining"]) = carry
+                out["rng"] = rng
+                packed = jnp.stack([
+                    toks.astype(jnp.float32),
+                    lps.astype(jnp.float32),
+                    valid.astype(jnp.float32),
+                ])
+                return out, packed
 
-            self._fns["step"] = jax.jit(step, donate_argnums=(1, 2, 3))
-        return self._fns["step"]
+            self._fns["dispatch"] = jax.jit(dispatch, donate_argnums=(1,))
+        return self._fns["dispatch"]
 
-    # ----------------------------------------------------------- the loop
+    # ------------------------------------------------------- admission
 
-    def _admit(self, req) -> None:
+    def _start_admission(self, req) -> None:
+        """Begin a chunked prefill for ``req`` (a free slot exists —
+        checked by the caller; slots only free up while it runs)."""
         from mlcomp_tpu.serve import left_pad_row
 
         jnp = self._jnp
-        slot = self._host.index(None)
         ids = req["ids"]
         s_bucket = self._bucket(len(ids))
+        c = min(self.prefill_chunk, s_bucket)
+        if s_bucket % c:
+            c = s_bucket  # odd bucket: fall back to one monolithic chunk
+        start_pad = s_bucket - len(ids)
+        first_chunk = start_pad // c  # all-pad chunks before are skipped
+        adm = _Admission(req, s_bucket, c, first_chunk)
         row, rmask = left_pad_row(ids, s_bucket, self.pad_id)
-        prompt, mask = row[None], rmask[None]
-        row_logits, row_cache = self._prefill_fn(s_bucket)(
-            self.variables, jnp.asarray(prompt), jnp.asarray(mask)
+        adm.row = row[None]
+        # chunk-invariant operands once per admission: positions stay
+        # host-side (each chunk uploads only its slice), the full-buffer
+        # kv_mask uploads ONCE (a per-chunk (1, l_buf) upload is exactly
+        # the small-transfer tax the device-carried state removed)
+        adm.positions = np.maximum(
+            np.cumsum(rmask.astype(np.int64)) - 1, 0
+        ).astype(np.int32)[None]
+        adm.kv_mask = jnp.asarray(np.concatenate(
+            [rmask[None], np.ones((1, self.l_buf - s_bucket), bool)], axis=1
+        ))
+        adm.cache = self._prefill_init_fn()(jnp.int32(first_chunk * c))
+        self._adm = adm
+
+    def _run_admission_chunk(self) -> None:
+        """Run ONE prefill chunk; on the last chunk, insert the row into
+        a free slot.  Called between decode dispatches so active rows
+        stall at most one chunk per boundary."""
+        jnp = self._jnp
+        adm = self._adm
+        c, s_bucket = adm.chunk, adm.s_bucket
+        lo = adm.next_chunk * c
+        logits, adm.cache = self._prefill_chunk_fn(c)(
+            self.variables, adm.cache,
+            jnp.asarray(adm.row[:, lo:lo + c]),
+            jnp.asarray(adm.positions[:, lo:lo + c]),
+            adm.kv_mask,
         )
+        adm.last_logits = logits
+        adm.next_chunk += 1
+        self._stats["prefill_chunks"] += 1
+        if adm.next_chunk < adm.n_chunks:
+            return
+        # last chunk done: its final logits are the last REAL token's
+        # (left-padding puts the prompt tail at the bucket end)
+        req = adm.req
+        slot = self._host.index(None)
         row_presence = np.zeros((1, self.vocab), bool)
         if req["repetition_penalty"] != 1.0:
-            row_presence[0, np.asarray(ids)] = True
-        self._cache, self._last_logits, self._presence = self._insert_fn()(
-            self._cache, self._last_logits, self._presence,
-            row_cache, row_logits, jnp.asarray(row_presence),
-            jnp.int32(slot),
+            row_presence[0, np.asarray(req["ids"])] = True
+        packed = np.asarray([
+            slot, s_bucket, len(req["ids"]), s_bucket - len(req["ids"]),
+            req["n_new"], req["eos_id"], req["temperature"], req["top_k"],
+            req["top_p"], req["repetition_penalty"],
+        ], np.float32)
+        self._dstate = self._insert_fn()(
+            self._dstate, adm.cache, adm.last_logits,
+            jnp.asarray(row_presence), jnp.asarray(packed),
         )
         self._host[slot] = _Slot(
             req,
             cursor=s_bucket,
-            position=len(ids),
-            start=s_bucket - len(ids),
+            position=len(req["ids"]),
+            start=s_bucket - len(req["ids"]),
             remaining=req["n_new"],
         )
         self._stats["prefills"] += 1
+        self._adm = None
 
     def _finish(self, slot_idx: int, error: Optional[Exception] = None):
         sl = self._host[slot_idx]
@@ -382,54 +649,35 @@ class DecodeEngine:
             result["logprobs"] = [round(lp, 5) for _, lp in sl.emitted]
         req["future"].set_result(result)
 
-    def _run_step(self) -> None:
-        jax, jnp = self._jax, self._jnp
-        cursors = np.zeros(self.slots, np.int32)
-        starts = np.zeros(self.slots, np.int32)
-        positions = np.zeros(self.slots, np.int32)
-        active = np.zeros(self.slots, bool)
-        t = np.zeros(self.slots, np.float32)
-        k = np.full(self.slots, self.vocab, np.int32)
-        p = np.ones(self.slots, np.float32)
-        rp = np.ones(self.slots, np.float32)
-        for i, sl in enumerate(self._host):
-            if sl is None:
-                continue
-            active[i] = True
-            cursors[i] = sl.cursor
-            starts[i] = sl.start
-            positions[i] = sl.position
-            t[i] = sl.req["temperature"]
-            k[i] = sl.req["top_k"]
-            p[i] = sl.req["top_p"]
-            rp[i] = sl.req["repetition_penalty"]
-        self._rng, sub = jax.random.split(self._rng)
-        out = self._step_fn()(
-            self.variables, self._cache, self._last_logits, self._presence,
-            jnp.asarray(cursors), jnp.asarray(starts), jnp.asarray(positions),
-            jnp.asarray(active), sub, jnp.asarray(t), jnp.asarray(k),
-            jnp.asarray(p), jnp.asarray(rp),
+    def _run_dispatch(self) -> None:
+        # steady state: one device call (state device-carried + donated)
+        # and one packed fetch — nothing per-slot is uploaded here
+        self._dstate, packed = self._dispatch_fn()(
+            self.variables, self._dstate
         )
-        self._cache, self._last_logits, self._presence = out[:3]
-        toks = np.asarray(out[3])
-        lps = np.asarray(out[4])
-        self.step_count += 1
-        self._stats["steps"] += 1
-        for i, sl in enumerate(self._host):
-            if sl is None:
-                continue
-            tok, lp = int(toks[i]), float(lps[i])
-            sl.emitted.append((tok, lp))
-            if sl.req["stream"] is not None:
-                sl.req["stream"].put({
-                    "token": tok, "logprob": round(lp, 5),
-                    "step": self.step_count,
-                })
-            sl.cursor += 1
-            sl.position += 1
-            sl.remaining -= 1
-            if sl.remaining <= 0 or tok == sl.req["eos_id"]:
-                self._finish(i)
+        arr = np.asarray(packed)     # (3, K, slots) f32, one transfer
+        toks = arr[0].astype(np.int32)
+        lps = arr[1]
+        valid = arr[2] > 0.5
+        self._stats["dispatches"] += 1
+        for kk in range(toks.shape[0]):
+            self.step_count += 1
+            self._stats["steps"] += 1
+            for i, sl in enumerate(self._host):
+                if sl is None or not valid[kk, i]:
+                    continue
+                tok, lp = int(toks[kk, i]), float(lps[kk, i])
+                sl.emitted.append((tok, lp))
+                if sl.req["stream"] is not None:
+                    sl.req["stream"].put({
+                        "token": tok, "logprob": round(lp, 5),
+                        "step": self.step_count,
+                    })
+                sl.cursor += 1
+                sl.position += 1
+                sl.remaining -= 1
+                if sl.remaining <= 0 or tok == sl.req["eos_id"]:
+                    self._finish(i)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -439,6 +687,8 @@ class DecodeEngine:
                     req = self._queue.get(timeout=0.2)
                 except queue.Empty:
                     continue
+                if req is _POISON:
+                    continue
                 if req["stream"] is not None:
                     req["stream"].put(None)
                 _fail_future(
@@ -447,24 +697,33 @@ class DecodeEngine:
                 )
                 continue
             try:
-                # admit as many queued requests as there are free slots —
-                # each joins at THIS step boundary
-                while None in self._host:
-                    block = all(s is None for s in self._host)
+                # one admission in flight at a time, one CHUNK of it per
+                # boundary: the joiner's prefill interleaves with decode
+                # dispatches instead of stalling them for a whole bucket
+                if self._adm is None and None in self._host:
+                    idle = all(s is None for s in self._host)
                     try:
-                        req = self._queue.get(timeout=0.2 if block else 0)
+                        req = self._queue.get(timeout=0.2 if idle else 0)
                     except queue.Empty:
-                        break
+                        req = None
+                    if req is _POISON:
+                        continue
+                    if req is not None:
+                        try:
+                            self._start_admission(req)
+                        except Exception as e:
+                            if req["stream"] is not None:
+                                req["stream"].put(None)
+                            _fail_future(req["future"], e)
+                if self._adm is not None:
                     try:
-                        self._admit(req)
+                        self._run_admission_chunk()
                     except Exception as e:
-                        if req["stream"] is not None:
-                            req["stream"].put(None)
-                        if not req["future"].done():
-                            req["future"].set_exception(e)
+                        self._fail_admission(e)
                 if any(s is not None for s in self._host):
-                    self._run_step()
+                    self._run_dispatch()
             except Exception as e:  # engine-level failure: fail active rows
                 self._broken = e
                 for i in range(self.slots):
                     self._finish(i, error=e)
+                self._fail_admission(e)

@@ -3,6 +3,7 @@ padding exactness, micro-batching of concurrent requests, HTTP round
 trip with token auth."""
 
 import json
+import time
 import threading
 import urllib.error
 import urllib.request
@@ -506,3 +507,68 @@ def test_serve_request_count_single_sourced():
         assert st["engine"]["requests"] == 1
     finally:
         svc.close()
+
+
+def test_window_batcher_defers_head_first_no_starvation():
+    """r3/r4 starvation case: a request whose max_new bucket mismatches
+    the batch head used to be re-queued at the TAIL, so a sustained
+    stream of the other bucket deferred it forever.  Now it heads the
+    NEXT batch: wait is bounded by one batch per deferral."""
+    from concurrent.futures import Future
+
+    _, svc = _service(batcher="window", batch_sizes=(1, 2),
+                      batch_window_ms=50.0)
+    # drive the collection policy deterministically: stop the batcher
+    # thread, then feed the adversarial arrival order by hand
+    svc._stop.set()
+    svc._thread.join(timeout=10)
+    assert not svc._thread.is_alive()
+
+    def item(name, nb):
+        return {"name": name, "bucket_new": nb, "future": Future()}
+
+    b1, a, b2, b3 = item("b1", 4), item("a", 8), item("b2", 4), item("b3", 4)
+    for it in (b1, a, b2, b3):
+        svc._queue.put(it)
+    first = svc._collect()
+    assert [i["name"] for i in first] == ["b1", "b2"]  # a deferred
+    assert [i["name"] for i in svc._deferred] == ["a"]
+    second = svc._collect()
+    assert [i["name"] for i in second] == ["a"]  # deferred heads next
+    third = svc._collect()
+    assert [i["name"] for i in third] == ["b3"]
+    # close() fails whatever is still parked in queue/deferred
+    svc._deferred = [item("late", 4)]
+    late = svc._deferred[0]["future"]
+    svc.close()
+    assert late.done() and isinstance(late.exception(), RuntimeError)
+
+
+def test_window_batcher_starvation_stream_end_to_end():
+    """The adversarial stream through the real service: the mismatched
+    request completes while the stream is still flowing (not last)."""
+    import threading as _th
+
+    _, svc = _service(batcher="window", batch_sizes=(1, 2),
+                      batch_window_ms=150.0, max_new_buckets=(2, 4))
+    done_order = []
+    lock = _th.Lock()
+
+    def track(name, fut):
+        fut.add_done_callback(
+            lambda f: (lock.acquire(), done_order.append(name),
+                       lock.release())
+        )
+        return fut
+
+    try:
+        futs = [track("b0", svc.submit([1, 2, 3], 2))]
+        futs.append(track("victim", svc.submit([1, 2, 3], 4)))
+        for i in range(6):
+            futs.append(track(f"b{i + 1}", svc.submit([1, 2, 3], 2)))
+            time.sleep(0.05)
+        for f in futs:
+            f.result(timeout=600)
+    finally:
+        svc.close()
+    assert done_order.index("victim") < len(done_order) - 1, done_order
