@@ -372,12 +372,13 @@ def bench_decode() -> "dict | None":
         ]
     ) * 2
     kv_bytes = (DEC_PROMPT + DEC_NEW) * LM_LAYERS * 2 * d * 2  # per row
-    # int8 cache: 1-byte K/V + per-(slot, head) f32 scales (~3% at
-    # dh=128); the full-buffer count matches what both paths read (XLA
-    # attends the whole masked buffer; the kernel clamps beyond the
-    # cursor, so this is conservative for it)
+    # int8 cache: 1-byte K/V + per-(slot, head) bf16 scales (~1.5% at
+    # dh=128; bf16 since round 5 — the roofline tracks what the
+    # implementation actually stores); the full-buffer count matches
+    # what both paths read (XLA attends the whole masked buffer; the
+    # kernel clamps beyond the cursor, so this is conservative for it)
     kv_bytes_int8 = (DEC_PROMPT + DEC_NEW) * LM_LAYERS * 2 * (
-        d + 4 * LM_HEADS
+        d + 2 * LM_HEADS
     )
     variants = {}
     for b, mode in combos:

@@ -362,7 +362,13 @@ class DecodeEngine:
         if self.quant_kernel:
             from mlcomp_tpu.ops.quant import quant_kernel_interception
 
-            with quant_kernel_interception():
+            # norm folding mirrors generate()'s decode path (engine
+            # greedy outputs must stay equal to generate's)
+            with quant_kernel_interception(
+                fold_norms=bool(
+                    getattr(self.model, "fold_norms_eligible", False)
+                )
+            ):
                 return self.model.apply(*args, **kwargs)
         return self.model.apply(*args, **kwargs)
 
@@ -680,6 +686,22 @@ class DecodeEngine:
                     self._finish(i)
 
     def _loop(self) -> None:
+        try:
+            self._loop_body()
+        finally:
+            # LOOP-OWNED final drain: whatever path ended the loop —
+            # close(), a fatal error, or a wedged dispatch finally
+            # returning after an abandoned close() — nothing may be
+            # left waiting on a future this thread will never resolve.
+            # Idempotent vs close()'s own drain (_finish clears the
+            # slot, _fail_future tolerates the loser of the race).
+            err = self._broken or RuntimeError("decode engine closed")
+            for i in range(self.slots):
+                self._finish(i, error=err)
+            self._fail_admission(err)
+            self._drain_queue(err)
+
+    def _loop_body(self) -> None:
         while not self._stop.is_set():
             if self._broken is not None:
                 # donated buffers may be gone: fail queued requests fast

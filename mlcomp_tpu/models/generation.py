@@ -299,7 +299,14 @@ def generate(
         if use_quant_kernel:
             from mlcomp_tpu.ops.quant import quant_kernel_interception
 
-            with quant_kernel_interception():
+            # fold RMSNorms into the consuming projection kernels on
+            # decode-GEMV shapes (models that declare every norm
+            # consumer dense-like; see quant_kernel_interception)
+            with quant_kernel_interception(
+                fold_norms=bool(
+                    getattr(model, "fold_norms_eligible", False)
+                )
+            ):
                 return model.apply(*args, **kwargs)
         return model.apply(*args, **kwargs)
 

@@ -148,7 +148,9 @@ def _kernel(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale                                   # (Hkv, Gp, BLK)
-        s = s * ks_ref[0]                           # K dequant on the logits
+        # K dequant on the logits; scales may be stored bf16 (round 5:
+        # halves the scale-cache write stream) — cast in VMEM
+        s = s * ks_ref[0].astype(jnp.float32)
         cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         s = jnp.where((cols >= lo) & (cols < hi), s, NEG_INF)
 
@@ -163,7 +165,8 @@ def _kernel(
             alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        pv = (p * vs_ref[0]).astype(q.dtype)        # V dequant on the probs
+        pv = (p * vs_ref[0].astype(jnp.float32)).astype(q.dtype)
+        # ^ V dequant on the probs (bf16 scale cast like K's)
         v = v_ref[0].astype(q.dtype)                # (Hkv, BLK, dh)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             pv, v, (((2,), (1,)), ((0,), (0,))),
@@ -193,8 +196,10 @@ def decode_attention(
     """Single-token attention against an int8 KV cache.
 
     q: (B, H, dh) current-token queries; k8/v8: (B, Hkv, L, dh) int8;
-    ks/vs: (B, Hkv, 1, L) f32 per-(slot, head) scales (the singleton
-    keeps the scale block TPU-tileable at zero byte cost);
+    ks/vs: (B, Hkv, 1, L) float per-(slot, head) scales — f32 or bf16
+    (the decode cache stores bf16 since round 5: halves the dominant
+    scale-write stream; the kernel upcasts in VMEM).  The singleton
+    keeps the scale block TPU-tileable at zero byte cost;
     kv_start/kv_stop: (B,) int32 valid-slot windows (default: the whole
     buffer).  L and dh must be lane multiples (the cache allocator
     rounds L up; dh pads).  Returns (B, H, dh) in q.dtype.
@@ -283,7 +288,7 @@ def decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, h_kv, gp, dh), q.dtype),
         interpret=interpret,
-    )(start, stop, qg, k8, ks.astype(jnp.float32), v8, vs.astype(jnp.float32))
+    )(start, stop, qg, k8, ks, v8, vs)
     return out[:, :, :rep].reshape(b, h, dh)
 
 

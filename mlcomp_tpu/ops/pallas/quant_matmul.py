@@ -58,6 +58,30 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, out_dtype):
         o_ref[:] = (acc_ref[:] * s_ref[0:1]).astype(out_dtype)
 
 
+def _kernel_norm(x_ref, g_ref, q_ref, s_ref, o_ref, *,
+                 out_dtype, norm_dtype, eps):
+    """RMSNorm folded into the matmul prologue (decode glue attack,
+    round 5): this variant REQUIRES the full contraction in one block
+    (block_d == D — the decode-GEMV auto-block layout), so the
+    row-wise norm is computed on the resident x block in VMEM and the
+    whole contraction finishes in this one grid step: no D-loop, no
+    accumulator scratch.  The standalone norm kernel, its HBM
+    round-trip of the normed activations, and its launch disappear
+    from the per-token step.  Math mirrors models/transformer.rmsnorm
+    exactly: f32 square-mean + rsqrt, scale, cast to the norm module's
+    dtype — then the usual bf16 MXU matmul."""
+    x32 = x_ref[:].astype(jnp.float32)             # (Bp, D) full rows
+    ms = jnp.mean(x32 * x32, axis=1, keepdims=True)
+    y = (
+        x32 * jax.lax.rsqrt(ms + eps) * g_ref[:].astype(jnp.float32)
+    ).astype(norm_dtype).astype(jnp.bfloat16)
+    q = q_ref[:].astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        y, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    o_ref[:] = (acc * s_ref[0:1]).astype(out_dtype)
+
+
 _GEMV_ROWS = 64  # row count at or below which the decode heuristic kicks in
 
 
@@ -95,6 +119,9 @@ def quant_matmul(
     block_d: int | None = None,
     interpret: bool | None = None,
     prebroadcast_scale: bool = False,
+    norm_scale: jax.Array | None = None,
+    norm_dtype=None,
+    norm_eps: float = 1e-6,
 ) -> jax.Array:
     """``x @ (q8 * scale)`` with the dequant fused into the kernel.
 
@@ -104,6 +131,16 @@ def quant_matmul(
     :func:`_auto_blocks`); pass them to pin a layout.  Falls back
     (NotImplementedError) when D or N don't tile; the caller
     (ops/quant.py dispatch) keeps the XLA path for those.
+
+    ``norm_scale`` ((D,) f32) additionally folds an RMSNorm of x into
+    the kernel prologue (``y = rmsnorm(x) @ (q8 * scale)``): x arrives
+    UN-normed in any float dtype, the norm runs in f32 on the resident
+    row, casts through ``norm_dtype`` (the norm module's output dtype)
+    to bf16, and the matmul proceeds as usual — the output is bf16
+    (what the un-fused path's pre-cast input would have produced).
+    Requires the full contraction in one block (block_d == D, the
+    decode-GEMV layout); raises NotImplementedError otherwise so the
+    caller can norm explicitly and retry.
     """
     b, d = x.shape
     d2, n = q8.shape
@@ -145,6 +182,16 @@ def quant_matmul(
         raise NotImplementedError(
             f"shapes must tile into lane multiples: D={d}, N={n}"
         )
+    if norm_scale is not None:
+        if block_d != d:
+            raise NotImplementedError(
+                f"norm folding needs the full contraction in one block "
+                f"(block_d == D); got block_d={block_d}, D={d}"
+            )
+        if norm_scale.shape != (d,):
+            raise ValueError(
+                f"norm_scale must be ({d},); got {norm_scale.shape}"
+            )
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
 
@@ -164,6 +211,32 @@ def quant_matmul(
         s2 = jnp.broadcast_to(
             scale.astype(jnp.float32)[None, :], (SUBLANES, n)
         )
+
+    if norm_scale is not None:
+        # fused-norm variant: x arrives un-normed (any float dtype);
+        # output is bf16 — exactly what the un-fused path's pre-cast
+        # normed input would have produced.  g rides as a (1, D) block
+        # (a free reshape — materializing an (8, D) broadcast per call
+        # measured ~0.6 us/call of pure in-loop glue)
+        g2 = norm_scale.astype(jnp.float32).reshape(1, d)
+        kernel = functools.partial(
+            _kernel_norm, out_dtype=jnp.bfloat16,
+            norm_dtype=norm_dtype or jnp.bfloat16, eps=norm_eps,
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid=(bp // block_b, n // block_n),
+            in_specs=[
+                pl.BlockSpec((block_b, d), lambda r, i: (r, 0)),
+                pl.BlockSpec((1, d), lambda r, i: (0, 0)),
+                pl.BlockSpec((d, block_n), lambda r, i: (0, i)),
+                pl.BlockSpec((SUBLANES, block_n), lambda r, i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((block_b, block_n), lambda r, i: (r, i)),
+            out_shape=jax.ShapeDtypeStruct((bp, n), jnp.bfloat16),
+            interpret=interpret,
+        )(x, g2, q8, s2)
+        return out[:b]
 
     kernel = functools.partial(_kernel, out_dtype=x.dtype)
     out = pl.pallas_call(

@@ -368,7 +368,7 @@ def sharded_quant_matmul(x2, q8, scale, mesh, row_parallel: bool,
     )(x2, q8, scale)
 
 
-def quant_kernel_interception():
+def quant_kernel_interception(fold_norms: bool = False):
     """Flax interception context: while active, ``nn.Dense`` /
     ``nn.DenseGeneral`` / ``nn.Embed`` modules whose parameter is an
     int8-quantized leaf compute through the Pallas kernel
@@ -376,6 +376,23 @@ def quant_kernel_interception():
     {"q8", "q8_scale"} dict.  Works on ANY model without model changes —
     the module tree is intercepted at apply time, so MoE and custom user
     models get the fast path for free wherever they use plain Dense/Embed.
+
+    ``fold_norms`` (round 5, decode glue attack) additionally folds
+    RMSNorm into the consuming projection kernel on decode-GEMV shapes:
+    an intercepted ``RMSNorm`` whose output would feed intercepted
+    projections returns its input UNCHANGED and stashes its scale; any
+    dense-like module whose input IS that stashed tensor (checked by
+    tracer identity — q/k/v sharing one norm all match, the out-proj
+    consuming attention output does not) applies the norm inside the
+    Pallas prologue (``quant_matmul(norm_scale=...)``) — or explicitly,
+    for shapes the kernel path declines — so the standalone norm
+    kernels and their activation round-trips leave the per-token step.
+    Only enable for models where EVERY RMSNorm output feeds dense-like
+    intercepted modules (``fold_norms_eligible`` on the model class;
+    TransformerLM qualifies, MoE's router/expert einsums do not).
+    Folding stays off under a mesh (the sharded islands don't take
+    norm operands) and off decode-GEMV shapes (rows > 64, d > 2048 or
+    non-lane d), where RMSNorm computes normally.
 
     Dense/DenseGeneral: ``out = quant_matmul(x, q8, scale)`` — dequant
     fused in VMEM, halving the decode-critical HBM weight read.  3-D
@@ -386,12 +403,16 @@ def quant_kernel_interception():
     out of the fold.  The matmul runs in bf16 with fp32 accumulation
     even for fp32-compute modules (lm_head):
     that mantissa trade is inherent to int8 weights anyway.
-    Embed: gather rows of q8 then scale (per-column scales are shared by
-    every row, so the gather commutes with dequantization).
+    Embed: gather rows of q8 then scale (per-column scales are shared
+    by every row, so the gather commutes with dequantization).
     """
     from flax import linen as nn
 
     from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+
+    # per-context norm stash: (tracer, scale, dtype) of the most recent
+    # skipped RMSNorm — tracer IDENTITY decides who consumes it
+    stash = {"x": None, "scale": None, "dtype": None}
 
     def contract_count(mod):
         """How many trailing input axes this module contracts against the
@@ -422,6 +443,28 @@ def quant_kernel_interception():
         mod = context.module
         if context.method_name != "__call__":
             return next_fun(*args, **kwargs)
+        pend = None
+        if fold_norms:
+            from mlcomp_tpu.models.transformer import RMSNorm, rmsnorm
+
+            if type(mod) is RMSNorm and args:
+                x = args[0]
+                d = x.shape[-1]
+                rows = math.prod(x.shape[:-1]) if x.ndim > 1 else 1
+                if (pallas_mesh() is None and rows <= 64 and d <= 2048
+                        and d % 128 == 0
+                        and mod.has_variable("params", "scale")):
+                    stash["x"] = x
+                    stash["scale"] = mod.get_variable("params", "scale")
+                    stash["dtype"] = mod.dtype
+                    return x  # consumer applies the norm (fused or not)
+                return next_fun(*args, **kwargs)
+            if stash["x"] is not None and args and args[0] is stash["x"]:
+                pend = (stash["scale"], stash["dtype"])
+
+            def normed_explicitly():
+                return rmsnorm(args[0], pend[0], pend[1])
+
         nc = contract_count(mod)
         if nc is not None and mod.has_variable("params", "kernel"):
             k = mod.get_variable("params", "kernel")
@@ -469,13 +512,26 @@ def quant_kernel_interception():
                     m = math.prod(q.shape[:nc])
                     n = math.prod(feats)
                 if factorable and m % 128 == 0 and n % 128 == 0:
-                    x2 = x.reshape(-1, m).astype(jnp.bfloat16)
+                    # fold the pending norm into the kernel prologue
+                    # when the layout allows (nc == 1 over the normed
+                    # axis; the stash conditions already guarantee the
+                    # full-row block the kernel needs)
+                    fuse_norm = (
+                        pend is not None and nc == 1 and m == x.shape[-1]
+                    )
+                    if pend is not None and not fuse_norm:
+                        x = normed_explicitly()
+                    x2 = x.reshape(-1, m)
+                    if not fuse_norm:
+                        x2 = x2.astype(jnp.bfloat16)
                     sv = s if prefolded else s.reshape(-1)
                     mesh = pallas_mesh()
                     if mesh is None:
                         out2 = quant_matmul(
                             x2, q.reshape(m, n), sv,
                             prebroadcast_scale=prefolded,
+                            norm_scale=pend[0] if fuse_norm else None,
+                            norm_dtype=pend[1] if fuse_norm else None,
                         )
                     else:
                         # multi-device: the kernel must run inside a
@@ -490,6 +546,8 @@ def quant_kernel_interception():
                         *x.shape[: x.ndim - nc], *feats
                     )
                 else:  # odd shape/scale layout: dequantize inline, still correct
+                    if pend is not None:
+                        x = normed_explicitly()
                     out = jax.lax.dot_general(
                         x.astype(out_dtype),
                         dequantize_leaf(k, out_dtype),
@@ -509,6 +567,11 @@ def quant_kernel_interception():
                 out_dtype = mod.dtype or jnp.float32
                 rows = jnp.take(e[_QKEY], ids, axis=0).astype(jnp.float32)
                 return (rows * e[_SKEY].reshape(-1)).astype(out_dtype)
+        if pend is not None:
+            # a dense-like module consuming the skipped norm's tensor
+            # without taking the kernel path (e.g. an unquantized
+            # kernel): the norm must still happen — explicitly, here
+            args = (normed_explicitly(),) + tuple(args[1:])
         return next_fun(*args, **kwargs)
 
     return nn.intercept_methods(interceptor)

@@ -463,3 +463,40 @@ def test_quant_matmul_prebroadcast_contract_is_explicit():
         quant_matmul(x, leaf["q8"], s8)  # no contract, no acceptance
     with _pytest.raises(ValueError, match="prebroadcast_scale"):
         quant_matmul(x, leaf["q8"], s1, prebroadcast_scale=True)
+
+
+def test_quant_matmul_fused_norm_matches_explicit():
+    """Round 5 glue attack: quant_matmul(norm_scale=...) computes
+    rmsnorm in the kernel prologue — must match the explicit
+    norm -> cast -> kernel pipeline to f32 tolerance (the mean's
+    reduce order may differ), and refuse layouts without full-row
+    blocks."""
+    import pytest as _pytest
+
+    from mlcomp_tpu.models.transformer import rmsnorm
+    from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
+
+    rs = np.random.RandomState(11)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        x = jnp.asarray(rs.normal(size=(8, 256)), dtype)
+        g = jnp.asarray(rs.normal(size=(256,)).astype(np.float32) + 1.0)
+        q8 = jnp.asarray(rs.randint(-127, 127, (256, 128)), jnp.int8)
+        scale = jnp.asarray(rs.random(128).astype(np.float32) * 0.01)
+        explicit = quant_matmul(
+            rmsnorm(x, g, dtype).reshape(-1, 256).astype(jnp.bfloat16),
+            q8, scale, interpret=True,
+        )
+        fused = quant_matmul(
+            x, q8, scale, interpret=True, norm_scale=g, norm_dtype=dtype,
+        )
+        np.testing.assert_allclose(
+            np.asarray(explicit, np.float32), np.asarray(fused, np.float32),
+            rtol=2e-2, atol=2e-2,  # bf16 matmul; norm reduce order differs
+        )
+    with _pytest.raises(NotImplementedError, match="full contraction"):
+        quant_matmul(
+            jnp.zeros((8, 4096), jnp.bfloat16),
+            jnp.zeros((4096, 128), jnp.int8),
+            jnp.ones((128,), jnp.float32),
+            interpret=True, norm_scale=jnp.ones((4096,)), block_d=2048,
+        )
