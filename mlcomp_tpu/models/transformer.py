@@ -262,7 +262,10 @@ class SelfAttention(nn.Module):
     def _decode_attention_quant(self, q, k, v, kv_mask, cache_cursor=None):
         """int8 KV-cache decode (``kv_quant=True``).
 
-        Cache layout is (B, Hkv, L, dh) int8 + (B, Hkv, L) f32 scales —
+        Cache layout is (B, Hkv, L, dh) int8 + (B, Hkv, 1, L) bf16
+        scales (bf16 storage halves the dominant masked full-buffer
+        scale rewrite; scales are still COMPUTED in f32 and the
+        flash-decode kernel upcasts in VMEM — round-5 glue attack) —
         KV-major so the flash-decode kernel walks contiguous tiles; L is
         lane-rounded at allocation (extra slots sit beyond ``kv_stop``,
         masked for free) and dh zero-pads to a lane multiple (pads add 0

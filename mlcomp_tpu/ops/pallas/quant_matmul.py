@@ -58,7 +58,7 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, out_dtype):
         o_ref[:] = (acc_ref[:] * s_ref[0:1]).astype(out_dtype)
 
 
-def _kernel_norm(x_ref, g_ref, q_ref, s_ref, o_ref, *,
+def _kernel_norm(x_ref, g_ref, q_ref, s_ref, o_ref, y_ref, *,
                  out_dtype, norm_dtype, eps):
     """RMSNorm folded into the matmul prologue (decode glue attack,
     round 5): this variant REQUIRES the full contraction in one block
@@ -69,15 +69,25 @@ def _kernel_norm(x_ref, g_ref, q_ref, s_ref, o_ref, *,
     round-trip of the normed activations, and its launch disappear
     from the per-token step.  Math mirrors models/transformer.rmsnorm
     exactly: f32 square-mean + rsqrt, scale, cast to the norm module's
-    dtype — then the usual bf16 MXU matmul."""
-    x32 = x_ref[:].astype(jnp.float32)             # (Bp, D) full rows
-    ms = jnp.mean(x32 * x32, axis=1, keepdims=True)
-    y = (
-        x32 * jax.lax.rsqrt(ms + eps) * g_ref[:].astype(jnp.float32)
-    ).astype(norm_dtype).astype(jnp.bfloat16)
+    dtype — then the usual bf16 MXU matmul.
+
+    The normed rows land in a VMEM scratch computed once per ROW block
+    (the n axis is the inner grid loop; the x block is grid-invariant
+    along it) — recomputing the norm per output-column block measured
+    as pure repeated VPU work on the widest shape (lm_head: 32 n-steps
+    re-norming the same 8 rows)."""
+    @pl.when(pl.program_id(1) == 0)
+    def _norm_rows():
+        x32 = x_ref[:].astype(jnp.float32)         # (Bp, D) full rows
+        ms = jnp.mean(x32 * x32, axis=1, keepdims=True)
+        y_ref[:] = (
+            x32 * jax.lax.rsqrt(ms + eps) * g_ref[:].astype(jnp.float32)
+        ).astype(norm_dtype).astype(jnp.bfloat16)
+
     q = q_ref[:].astype(jnp.bfloat16)
     acc = jax.lax.dot_general(
-        y, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        y_ref[:], q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     o_ref[:] = (acc * s_ref[0:1]).astype(out_dtype)
 
@@ -234,6 +244,7 @@ def quant_matmul(
             ],
             out_specs=pl.BlockSpec((block_b, block_n), lambda r, i: (r, i)),
             out_shape=jax.ShapeDtypeStruct((bp, n), jnp.bfloat16),
+            scratch_shapes=[pltpu.VMEM((block_b, d), jnp.bfloat16)],
             interpret=interpret,
         )(x, g2, q8, s2)
         return out[:b]

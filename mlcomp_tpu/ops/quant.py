@@ -23,7 +23,9 @@ No upstream analog (the reference has no inference quantization); usage:
 
 from __future__ import annotations
 
+import contextlib
 import math
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -290,6 +292,39 @@ def fold_kernel_leaves(params):
 # carry the shards).  Mirrors parallel/sharding.py's TP_RULES.
 _ROW_PARALLEL_NAMES = ("out", "o", "out_proj", "attn_out", "down",
                        "mlp_out", "output")
+# names the zoo/TP_RULES pin column-parallel; a kernel-consumable module
+# named in NEITHER list still runs (column island — shard_map reshards,
+# so it is mathematically correct) but pays a hidden resharding
+# collective if its weight was actually laid out row-parallel, so the
+# default is surfaced once per name instead of applied silently
+_COL_PARALLEL_NAMES = ("q", "k", "v", "qkv", "query", "key", "value",
+                       "gate", "up", "gate_up", "mlp_in", "intermediate",
+                       "lm_head")
+_warned_tp_roles: set = set()
+
+
+def _tp_role(name: str) -> bool:
+    """Megatron role for a quantized kernel island: True = row-parallel.
+
+    Unknown names (custom modules outside the zoo's naming) default to
+    column-parallel with a one-time warning (r4 verdict weak #5) — the
+    result is correct either way, but a wrong role turns the island's
+    single psum into an implicit all-to-all on entry.
+    """
+    if name in _ROW_PARALLEL_NAMES:
+        return True
+    if name not in _COL_PARALLEL_NAMES and name not in _warned_tp_roles:
+        _warned_tp_roles.add(name)
+        warnings.warn(
+            f"quantized module name {name!r} is not in the known Megatron "
+            "role tables; defaulting its shard_map island to "
+            "COLUMN-parallel. Correct, but if its weight is sharded along "
+            "the contraction dim this inserts a resharding collective — "
+            "extend ops.quant._ROW_PARALLEL_NAMES/_COL_PARALLEL_NAMES to "
+            "pin the role.",
+            stacklevel=3,
+        )
+    return False
 
 
 def pallas_mesh():
@@ -368,6 +403,15 @@ def sharded_quant_matmul(x2, q8, scale, mesh, row_parallel: bool,
     )(x2, q8, scale)
 
 
+_DROPPED_NORM_MSG = (
+    "fold_norms: a skipped RMSNorm's output never reached a dense-like "
+    "consumer — its normalization would be silently DROPPED. Something "
+    "now interposes between the norm and its projection (a cast, "
+    "dropout, or custom op breaks the tracer-identity match); this "
+    "model must not set fold_norms_eligible."
+)
+
+
 def quant_kernel_interception(fold_norms: bool = False):
     """Flax interception context: while active, ``nn.Dense`` /
     ``nn.DenseGeneral`` / ``nn.Embed`` modules whose parameter is an
@@ -411,8 +455,13 @@ def quant_kernel_interception(fold_norms: bool = False):
     from mlcomp_tpu.ops.pallas.quant_matmul import quant_matmul
 
     # per-context norm stash: (tracer, scale, dtype) of the most recent
-    # skipped RMSNorm — tracer IDENTITY decides who consumes it
-    stash = {"x": None, "scale": None, "dtype": None}
+    # skipped RMSNorm — tracer IDENTITY decides who consumes it.
+    # ``consumed`` guards the silent-wrong mode: a skipped norm whose
+    # tensor never reaches a dense-like consumer (someone interposed a
+    # cast/dropout between norm and projection) would otherwise simply
+    # VANISH from the computation; instead the next stash (or context
+    # exit) raises.
+    stash = {"x": None, "scale": None, "dtype": None, "consumed": False}
 
     def contract_count(mod):
         """How many trailing input axes this module contracts against the
@@ -454,13 +503,17 @@ def quant_kernel_interception(fold_norms: bool = False):
                 if (pallas_mesh() is None and rows <= 64 and d <= 2048
                         and d % 128 == 0
                         and mod.has_variable("params", "scale")):
+                    if stash["x"] is not None and not stash["consumed"]:
+                        raise RuntimeError(_DROPPED_NORM_MSG)
                     stash["x"] = x
                     stash["scale"] = mod.get_variable("params", "scale")
                     stash["dtype"] = mod.dtype
+                    stash["consumed"] = False
                     return x  # consumer applies the norm (fused or not)
                 return next_fun(*args, **kwargs)
             if stash["x"] is not None and args and args[0] is stash["x"]:
                 pend = (stash["scale"], stash["dtype"])
+                stash["consumed"] = True
 
             def normed_explicitly():
                 return rmsnorm(args[0], pend[0], pend[1])
@@ -539,7 +592,7 @@ def quant_kernel_interception(fold_norms: bool = False):
                         # role (serve --mesh + quantize "kernel")
                         out2 = sharded_quant_matmul(
                             x2, q.reshape(m, n), sv, mesh,
-                            row_parallel=mod.name in _ROW_PARALLEL_NAMES,
+                            row_parallel=_tp_role(mod.name),
                             prebroadcast_scale=prefolded,
                         )
                     out = out2.astype(out_dtype).reshape(
@@ -574,7 +627,17 @@ def quant_kernel_interception(fold_norms: bool = False):
             args = (normed_explicitly(),) + tuple(args[1:])
         return next_fun(*args, **kwargs)
 
-    return nn.intercept_methods(interceptor)
+    @contextlib.contextmanager
+    def ctx():
+        with nn.intercept_methods(interceptor):
+            yield
+            # clean exit only (an exception already propagates): the
+            # last skipped norm must have been consumed, or the model
+            # silently computed on un-normed activations
+            if stash["x"] is not None and not stash["consumed"]:
+                raise RuntimeError(_DROPPED_NORM_MSG)
+
+    return ctx()
 
 
 def has_quantized(params) -> bool:

@@ -1,0 +1,169 @@
+"""Seed a demo task store so the report dashboard has something to show.
+
+Populates every surface the dashboard renders: two DAGs (one finished
+with mixed task outcomes, one mid-flight so the stop/restart action
+links appear), per-task metric series, logs, a classification report
+(PR curves + confusion + worst-mistake gallery), a segmentation report,
+a declared layout artifact, and worker heartbeats with host metrics.
+
+Usage::
+
+    python tools/demo_store.py /tmp/demo.db
+    python -m mlcomp_tpu.cli report --db /tmp/demo.db --port 8765
+
+Used by the round-5 browser verification of the dashboard JS (SURVEY
+§6): the ~250 lines of chart/DAG/action script had only ever been
+curl-verified; this store plus a real browser executes them all.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from mlcomp_tpu.dag.schema import DagSpec, TaskSpec, TaskStatus
+from mlcomp_tpu.db.store import Store
+from mlcomp_tpu.report.artifacts import (
+    classification_report,
+    layout_payload,
+    segmentation_report,
+)
+
+
+def run_task(store, dag_id, name, worker, status=TaskStatus.SUCCESS,
+             error=None):
+    """Drive one task through the real lifecycle (queue -> claim ->
+    finish) so worker/started/finished columns fill in like production."""
+    store.set_task_status(dag_id, [name], TaskStatus.QUEUED)
+    row = store.claim_task(worker, free_chips=1024, free_hosts=64)
+    assert row is not None and row["name"] == name, (name, row)
+    if status is not TaskStatus.IN_PROGRESS:
+        store.finish_task(row["id"], status, error=error)
+    return row["id"]
+
+
+def curve(n, start, end, noise, rng, floor=None):
+    xs = np.arange(n)
+    decay = start + (end - start) * (1 - np.exp(-3.0 * xs / n))
+    vals = decay + rng.normal(0, noise, n)
+    if floor is not None:
+        vals = np.maximum(vals, floor)
+    return [(int(s * 50), float(v)) for s, v in zip(xs, vals)]
+
+
+def seed(path: str) -> None:
+    rng = np.random.default_rng(0)
+    store = Store(path)
+
+    # --- DAG 1: finished grid experiment with one failure -------------
+    tasks = [
+        TaskSpec(name="prepare", executor="shell", stage="data"),
+        TaskSpec(name="train_lr_1e-3", executor="train", depends=("prepare",),
+                 stage="train", grid_index=0,
+                 grid_params=(("lr", 1e-3),)),
+        TaskSpec(name="train_lr_3e-4", executor="train", depends=("prepare",),
+                 stage="train", grid_index=1,
+                 grid_params=(("lr", 3e-4),)),
+        TaskSpec(name="train_lr_1e-4", executor="train", depends=("prepare",),
+                 stage="train", grid_index=2,
+                 grid_params=(("lr", 1e-4),)),
+        TaskSpec(name="valid_best", executor="valid",
+                 depends=("train_lr_1e-3", "train_lr_3e-4", "train_lr_1e-4"),
+                 stage="valid"),
+        TaskSpec(name="infer_test", executor="infer", depends=("valid_best",),
+                 stage="infer"),
+    ]
+    dag1 = store.submit_dag(DagSpec(
+        name="cifar_grid", project="demo", tasks=tuple(tasks)))
+
+    tid = run_task(store, dag1, "prepare", "tpu-vm-0")
+    store.log(tid, "INFO", "tokenized 50k samples")
+
+    for i, (name, lr) in enumerate(
+            [("train_lr_1e-3", 1e-3), ("train_lr_3e-4", 3e-4),
+             ("train_lr_1e-4", 1e-4)]):
+        if name == "train_lr_1e-4":   # one failed leg: error column + chip
+            tid = run_task(store, dag1, name, "tpu-vm-0",
+                           status=TaskStatus.FAILED,
+                           error="loss diverged at step 450")
+            store.log(tid, "ERROR", "nan loss at step 450, aborting")
+            for s, v in curve(9, 2.3, 8.0, 0.3, rng):
+                store.metric(tid, "train/loss", v, s)
+            continue
+        tid = run_task(store, dag1, name, "tpu-vm-0")
+        loss = curve(40, 2.3, 0.4 + 0.1 * i, 0.05, rng, floor=0.05)
+        acc = curve(40, 0.1, 0.92 - 0.03 * i, 0.01, rng)
+        for s, v in loss:
+            store.metric(tid, "train/loss", v, s)
+        for s, v in acc:
+            store.metric(tid, "valid/accuracy", min(v, 0.99), s)
+        store.metric(tid, "lr", lr, 0)
+        store.log(tid, "INFO", f"started with lr={lr}")
+        store.log(tid, "INFO", f"finished: accuracy {acc[-1][1]:.4f}")
+
+    # valid_best: classification report + declared layout
+    tid = run_task(store, dag1, "valid_best", "tpu-vm-1")
+    n, k = 600, 4
+    y = rng.integers(0, k, n)
+    logits = rng.normal(0, 1, (n, k))
+    logits[np.arange(n), y] += 2.2          # mostly-right model
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    store.add_report(tid, "valid_cls", classification_report(
+        y, probs, class_names=["plane", "car", "bird", "cat"]))
+    store.add_report(tid, "layout", layout_payload([
+        {"type": "series", "metrics": ["valid/accuracy"],
+         "title": "accuracy (declared layout)"},
+        {"type": "summary"}, {"type": "pr_curves"}, {"type": "confusion"},
+    ]))
+    for s in range(12):
+        store.metric(tid, "valid/accuracy",
+                     0.7 + 0.02 * s + rng.normal(0, 0.004), s * 100)
+    store.log(tid, "INFO", "selected train_lr_1e-3 as best")
+
+    # infer_test: segmentation report (exercises the other renderer)
+    tid = run_task(store, dag1, "infer_test", "tpu-vm-1")
+    yt = rng.integers(0, 3, (8, 32, 32))
+    yp = yt.copy()
+    flip = rng.random(yt.shape) < 0.12
+    yp[flip] = rng.integers(0, 3, int(flip.sum()))
+    store.add_report(tid, "seg_eval", segmentation_report(
+        yt, yp, class_names=["bg", "road", "car"]))
+    store.log(tid, "INFO", "wrote 8 masks")
+
+    # --- DAG 2: mid-flight (stop links + warn chips + graph colors) ---
+    tasks2 = [
+        TaskSpec(name="tokenize", executor="shell", stage="data"),
+        TaskSpec(name="pretrain", executor="train", depends=("tokenize",),
+                 stage="train"),
+        TaskSpec(name="eval_ppl", executor="valid", depends=("pretrain",),
+                 stage="valid"),
+    ]
+    dag2 = store.submit_dag(DagSpec(
+        name="lm_pretrain", project="demo", tasks=tuple(tasks2)))
+    run_task(store, dag2, "tokenize", "tpu-vm-0")
+    pre = run_task(store, dag2, "pretrain", "tpu-vm-0",
+                   status=TaskStatus.IN_PROGRESS)
+    for s, v in curve(25, 9.8, 3.1, 0.08, rng):
+        store.metric(pre, "train/loss", v, s)
+    store.metric(pre, "train/tokens_per_sec", 17404.7, 0)
+    store.log(pre, "INFO", "step 1250: loss 3.41")
+
+    # --- workers ------------------------------------------------------
+    store.heartbeat("tpu-vm-0", chips=4, busy_chips=4, info={
+        "load1": 3.2, "mem_free_gb": 187.4,
+        "tasks": [pre],
+    })
+    store.heartbeat("tpu-vm-1", chips=4, busy_chips=0, info={
+        "load1": 0.1, "mem_free_gb": 305.0, "tasks": [],
+    })
+    store.heartbeat("tpu-vm-2", chips=4, busy_chips=0,
+                    info={"load1": 0.0, "mem_free_gb": 300.1, "tasks": []})
+    store.mark_worker_dead("tpu-vm-2")
+
+    store.close()
+    print(f"seeded {path}: 2 dags, {len(tasks) + len(tasks2)} tasks")
+
+
+if __name__ == "__main__":
+    seed(sys.argv[1] if len(sys.argv) > 1 else "/tmp/mlcomp_demo.db")
