@@ -602,39 +602,29 @@ def bench_engine(scan_variants=None) -> None:
     print(json.dumps(line))
 
 
-def bench_quality() -> None:
-    """Quantization QUALITY gate (r4 verdict missing #3): the serving
-    headline is an all-int8 config whose speed was measured to death
-    while its accuracy cost was never quantified.  This line trains the
-    small byte-level LM fixture on real text — the repo's own source
-    and docs through the ``cli tokenize`` → ``token_bin`` path — then
-    reports teacher-forced perplexity on a held-out slice for bf16 vs
-    int8 weights (Pallas kernel) vs int8 KV vs all-int8.
+_QUALITY_FIXTURE = None
 
-    Perplexity is evaluated through the DECODE path (single-token
-    steps against the KV cache), not a full forward: prefill attends
-    fresh bf16 K/V, so a full-forward eval would never read the int8
-    cache that serving reads every step.  All variants share the same
-    trained weights and the same eval tokens; the deltas are the
-    quantization cost, not training noise."""
+
+def _quality_fixture():
+    """Train (once per process) the small byte-level LM on real text —
+    the repo's own source and docs through ``cli tokenize`` →
+    ``token_bin`` — and return
+    ``(params, q_cfg, stream, train_rows, seq, train_loss, steps)``.
+    Shared by the quality (perplexity) and speculative lines so the
+    training cost is paid once."""
+    global _QUALITY_FIXTURE
+    if _QUALITY_FIXTURE is not None:
+        return _QUALITY_FIXTURE
     import gc
     import subprocess
     import sys
     import tempfile
-    from functools import partial
 
-    from mlcomp_tpu.models import create_model
-    from mlcomp_tpu.models.generation import init_cache
-    from mlcomp_tpu.ops.quant import (
-        dequantize_nonkernel_params, fold_kernel_leaves,
-        quant_kernel_interception, quantize_params,
-    )
     from mlcomp_tpu.train.loop import Trainer
 
     workdir = tempfile.mkdtemp(prefix="mlcomp_quality_")
     bin_path = os.path.join(workdir, "corpus.bin")
-    # the corpus: this repo's own Python + Markdown (real prose + code,
-    # deterministic, no egress needed), byte-level ids 0-255 + EOS 256
+    # byte-level ids 0-255 + EOS 256; deterministic, no egress needed
     root = os.path.dirname(os.path.abspath(__file__))
     subprocess.run(
         [sys.executable, "-m", "mlcomp_tpu.cli", "tokenize",
@@ -674,6 +664,37 @@ def bench_quality() -> None:
     params = jax.device_get(trainer.state.params)
     del trainer
     gc.collect()
+    _QUALITY_FIXTURE = (
+        params, q_cfg, stream, train_rows, seq, train_loss,
+        epochs * steps_per_epoch,
+    )
+    return _QUALITY_FIXTURE
+
+
+def bench_quality() -> None:
+    """Quantization QUALITY gate (r4 verdict missing #3): the serving
+    headline is an all-int8 config whose speed was measured to death
+    while its accuracy cost was never quantified.  This line trains the
+    small byte-level LM fixture on real text — the repo's own source
+    and docs through the ``cli tokenize`` → ``token_bin`` path — then
+    reports teacher-forced perplexity on a held-out slice for bf16 vs
+    int8 weights (Pallas kernel) vs int8 KV vs all-int8.
+
+    Perplexity is evaluated through the DECODE path (single-token
+    steps against the KV cache), not a full forward: prefill attends
+    fresh bf16 K/V, so a full-forward eval would never read the int8
+    cache that serving reads every step.  All variants share the same
+    trained weights and the same eval tokens; the deltas are the
+    quantization cost, not training noise."""
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.generation import init_cache
+    from mlcomp_tpu.ops.quant import (
+        dequantize_nonkernel_params, fold_kernel_leaves,
+        quant_kernel_interception, quantize_params,
+    )
+
+    (params, q_cfg, stream, train_rows, seq, train_loss,
+     train_steps) = _quality_fixture()
 
     eval_x = jnp.asarray(np.array(
         stream[train_rows * seq: (train_rows + 8) * seq]
@@ -735,10 +756,142 @@ def bench_quality() -> None:
         "unit": "% ppl increase (all-int8 vs bf16, decode path)",
         "ppl": ppl,
         "train_loss_final": round(train_loss, 4),
-        "train_steps": epochs * steps_per_epoch,
+        "train_steps": train_steps,
         "corpus_tokens": int(len(stream)),
         "eval_tokens": int(eval_x.size),
         "vs_baseline": None,
+    }))
+
+
+def bench_speculative() -> None:
+    """SPECULATIVE-DECODE line (round 5, beyond-parity): B=1 greedy
+    decode of real text on the trained byte-LM fixture, vanilla
+    ``generate`` scan vs ``speculative_generate`` (n-gram prompt-lookup
+    draft, K=8, models/speculative.py), bf16 and all-int8 weights.
+
+    Methodology: BOTH loops are single device programs (``lax.scan`` /
+    ``lax.while_loop``), so one wall-clock = one dispatch and the
+    tunnel RTT amortizes over the whole 256-token generation —
+    end-to-end timing is tunnel-safe here (unlike the engine's
+    per-dispatch path).  The prompt is the held-out corpus slice the
+    model never trained on; ``tokens_per_forward`` (= emitted/steps) is
+    the acceptance the text actually admitted.  Correctness is pinned
+    by tests (greedy equality vs generate for every mode); this line
+    only prices it.  ``vs_baseline`` = speedup over the vanilla scan
+    (int8 variant — the serving config)."""
+    import gc
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.generation import generate
+    from mlcomp_tpu.models.speculative import speculative_generate
+    from mlcomp_tpu.ops.quant import quantize_params
+    from mlcomp_tpu.train.state import init_model
+
+    n_new = 256
+    spec_k = 8
+
+    def measure(name, model, variables, prompt, quant_kernel):
+        # weights must be DEVICE-resident before timing: the trained
+        # fixture params come back from device_get as numpy, and a
+        # jitted call with numpy operands re-uploads every byte through
+        # the tunnel per call (~4 s/call for 172 MB — measured; it
+        # swamped the first cut of this line)
+        variables = jax.device_put(variables)
+        gen_fn = jax.jit(lambda v, p: generate(
+            model, v, p, n_new, quant_kernel=quant_kernel
+        ))
+        spec_fn = jax.jit(lambda v, p: speculative_generate(
+            model, v, p, n_new, spec_k=spec_k,
+            quant_kernel=quant_kernel, with_stats=True,
+        ))
+        ref = np.asarray(gen_fn(variables, prompt))   # compile + warm
+        spec_ids, stats = spec_fn(variables, prompt)
+        # agreement vs the scan path: the verify (s=K+1) and the
+        # single-token step are different compiled programs, so bf16
+        # steps with a top-2 margin below cross-program float noise
+        # can legitimately pick the other near-tied token; report the
+        # first divergence instead of asserting bitwise equality
+        # (tests pin exact equality on the f32 fixtures)
+        sa = np.asarray(spec_ids)[0]
+        agree = int(np.argmin(sa == ref[0])) if not np.array_equal(
+            sa, ref[0]
+        ) else len(sa)
+        prompt_len = prompt.shape[1]
+        gen_w, spec_w = [], []
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            np.asarray(gen_fn(variables, prompt)[0, -1])
+            gen_w.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            np.asarray(spec_fn(variables, prompt)[0][0, -1])
+            spec_w.append(time.perf_counter() - t0)
+        gw, sw = statistics.median(gen_w), statistics.median(spec_w)
+        steps = int(stats["steps"])
+        return {
+            "vanilla_tokens_per_sec": round(n_new / gw, 1),
+            "spec_tokens_per_sec": round(n_new / sw, 1),
+            "speedup": round(gw / sw, 3),
+            "tokens_per_forward": round(n_new / max(steps, 1), 2),
+            "verify_forwards": steps,
+            # new tokens agreeing with the generate scan before the
+            # first (near-tie) divergence, out of n_new
+            "greedy_agreement": max(agree - prompt_len, 0),
+        }
+
+    out = {}
+    # (1) trained byte-LM on held-out REAL text: the acceptance-realism
+    # evidence (the draft faces text the model actually models)
+    (params, q_cfg, stream, train_rows, seq, _loss, _steps) = (
+        _quality_fixture()
+    )
+    model = create_model(q_cfg)
+    prompt = jnp.asarray(np.array(
+        stream[train_rows * seq: train_rows * seq + 256]
+    ).astype(np.int32))[None]
+    out["fixture_43m_bf16"] = measure(
+        "fixture_43m_bf16", model, {"params": params}, prompt, False
+    )
+    out["fixture_43m_int8"] = measure(
+        "fixture_43m_int8", model,
+        {"params": quantize_params(params, min_size=4096)}, prompt, True
+    )
+
+    # (2) the serving-scale model (the b1 headline config minus
+    # kv_quant — the s>1 verify on the int8 cache takes the XLA
+    # dequant branch, which re-reads the whole cache per forward and
+    # eats the win; bf16 KV + int8 weights is the spec-friendly
+    # config): weight bytes dominate a B=1 step here, so K+1-wide
+    # verify costs ~one step and acceptance converts ~directly to
+    # speedup.  Weights are untrained (the 1.2B fixture has no trained
+    # checkpoint) — acceptance reflects the cycle-prone untrained
+    # greedy stream, so the FIXTURE line above is the acceptance
+    # evidence; this line is the big-model cost-structure evidence.
+    big_cfg = {
+        "name": "transformer_lm", "vocab_size": LM_VOCAB,
+        "hidden": LM_HIDDEN, "layers": LM_LAYERS, "heads": LM_HEADS,
+        "mlp_dim": 4 * LM_HIDDEN, "dtype": "bfloat16",
+        "decode_fused": True,
+    }
+    big = create_model(big_cfg)
+    gen = np.random.default_rng(11)
+    bprompt = jnp.asarray(
+        gen.integers(1, LM_VOCAB, size=(1, 512)), jnp.int32
+    )
+    bparams, _ = init_model(big, {"x": bprompt}, jax.random.PRNGKey(0))
+    bvars = {"params": quantize_params(bparams)}
+    del bparams
+    gc.collect()
+    out["lm_1p2b_int8"] = measure(
+        "lm_1p2b_int8", big, bvars, bprompt, True
+    )
+    print(json.dumps({
+        "metric": "speculative_decode_b1_tokens_per_sec",
+        "value": out["lm_1p2b_int8"]["spec_tokens_per_sec"],
+        "unit": "tokens/sec (1.2B B=1 greedy, ngram draft K=8)",
+        "generated": n_new,
+        "spec_k": spec_k,
+        "variants": out,
+        "vs_baseline": out["lm_1p2b_int8"]["speedup"],
     }))
 
 
@@ -1027,6 +1180,8 @@ def main() -> None:
         bench_scheduler_scaling()
     if on("MLCOMP_BENCH_SKIP_QUALITY"):
         bench_quality()
+    if on("MLCOMP_BENCH_SKIP_SPEC"):
+        bench_speculative()
     variants = None
     if on("MLCOMP_BENCH_SKIP_DECODE"):
         variants = bench_decode()

@@ -165,70 +165,19 @@ def sample_token_rowwise(
     )
 
 
-def generate(
-    model,
-    variables: Dict[str, Any],
-    prompt: jax.Array,
-    max_new_tokens: int,
-    *,
-    prompt_mask: Optional[jax.Array] = None,
-    temperature: float = 0.0,
-    top_k: Optional[int] = None,
-    top_p: Optional[float] = None,
-    eos_id: Optional[int] = None,
-    pad_id: int = 0,
-    rng: Optional[jax.Array] = None,
-    weights_dtype=None,
-    quant_kernel: bool = False,
-    with_logprobs: bool = False,
-    repetition_penalty: Optional[jax.Array] = None,
-):
-    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
+def prep_decode_variables(model, variables, quant_kernel, weights_dtype):
+    """Decode-loop weight prep shared by ``generate`` and
+    ``speculative_generate``: int8 entry-dequant or kernel-fold (with the
+    optimization barrier that pins ONE materialized copy outside the
+    token loop), optional bf16 pre-cast, and the apply wrapper that
+    routes quantized leaves through the Pallas interception (with norm
+    folding for models that declare ``fold_norms_eligible``).
 
-    - ``variables``: the model's non-cache variables ({"params": ...});
-      may carry int8 weight-only quantized leaves from
-      ``ops.quant.quantize_params`` — dequantized once at entry (see the
-      measured trade-offs below).
-    - ``weights_dtype``: opt-in pre-cast of large weight matrices before
-      the token loop (bf16 ≈ 1.4× decode on v5e vs fp32 masters; costs
-      weight-mantissa precision on fp32-compute heads).  None (default)
-      leaves dtypes untouched.
-    - ``prompt_mask`` (B, S): True on real tokens, False on LEFT-padding;
-      pad rows get RoPE positions counted from their first real token and
-      their pad slots never attend.
-    - ``eos_id``: rows emit ``pad_id`` after producing ``eos_id``.
-    - sampling knobs: floats/ints trace STATICALLY (distinct values =
-      distinct programs; the simple path).  Passing ``temperature`` as
-      a (B,) ARRAY switches to per-ROW sampling (``top_k``/``top_p``
-      arrays optional then, neutral per row when omitted): one compiled
-      program serves any knob mix — what the serving daemon batches
-      mixed requests with.
-    - ``repetition_penalty`` (rowwise only, (B,) floats, 1.0 = off):
-      tokens already seen (real prompt ids + everything generated so
-      far, tracked as a (B, V) presence mask carried through the scan)
-      get the HF-convention adjustment (positive logits divided,
-      negative multiplied) BEFORE greedy/sampling; reported logprobs
-      stay raw-model.
-
-    Returns (B, S + max_new_tokens) int32 ids (prompt included; padding
-    preserved as given).  With ``with_logprobs=True`` (static — a
-    second program variant) returns ``(ids, logprobs)`` where logprobs
-    is (B, max_new_tokens) f32: the RAW-model log-probability of each
-    emitted token (log_softmax of the unfiltered, untempered logits —
-    the serving-API convention, so values are comparable across
-    sampling settings); rows already past EOS report 0.0.
+    Returns ``(variables, apply_model)`` — ``apply_model`` closes over
+    the interception choice, ``variables`` over the prep.  The measured
+    trade-offs live in the comments below.
     """
     from mlcomp_tpu.ops.quant import dequantize_params, has_quantized
-
-    prompt = prompt.astype(jnp.int32)
-    b, s = prompt.shape
-    if max_new_tokens <= 0:
-        if with_logprobs:
-            return prompt, jnp.zeros((b, 0), jnp.float32)
-        return prompt
-    total = s + max_new_tokens
-    cache = init_cache(model, b, total)
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     # Decode reads every weight once per token, so weight bytes bound the
     # step time.  Two int8 modes:
@@ -290,10 +239,6 @@ def generate(
             variables,
         )
         variables = jax.lax.optimization_barrier(variables)
-    fixed = variables
-
-    def model_vars(cache):
-        return {**fixed, "cache": cache}
 
     def apply_model(*args, **kwargs):
         if use_quant_kernel:
@@ -309,6 +254,79 @@ def generate(
             ):
                 return model.apply(*args, **kwargs)
         return model.apply(*args, **kwargs)
+
+    return variables, apply_model
+
+
+def generate(
+    model,
+    variables: Dict[str, Any],
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    prompt_mask: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    rng: Optional[jax.Array] = None,
+    weights_dtype=None,
+    quant_kernel: bool = False,
+    with_logprobs: bool = False,
+    repetition_penalty: Optional[jax.Array] = None,
+):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
+
+    - ``variables``: the model's non-cache variables ({"params": ...});
+      may carry int8 weight-only quantized leaves from
+      ``ops.quant.quantize_params`` — dequantized once at entry (see the
+      measured trade-offs below).
+    - ``weights_dtype``: opt-in pre-cast of large weight matrices before
+      the token loop (bf16 ≈ 1.4× decode on v5e vs fp32 masters; costs
+      weight-mantissa precision on fp32-compute heads).  None (default)
+      leaves dtypes untouched.
+    - ``prompt_mask`` (B, S): True on real tokens, False on LEFT-padding;
+      pad rows get RoPE positions counted from their first real token and
+      their pad slots never attend.
+    - ``eos_id``: rows emit ``pad_id`` after producing ``eos_id``.
+    - sampling knobs: floats/ints trace STATICALLY (distinct values =
+      distinct programs; the simple path).  Passing ``temperature`` as
+      a (B,) ARRAY switches to per-ROW sampling (``top_k``/``top_p``
+      arrays optional then, neutral per row when omitted): one compiled
+      program serves any knob mix — what the serving daemon batches
+      mixed requests with.
+    - ``repetition_penalty`` (rowwise only, (B,) floats, 1.0 = off):
+      tokens already seen (real prompt ids + everything generated so
+      far, tracked as a (B, V) presence mask carried through the scan)
+      get the HF-convention adjustment (positive logits divided,
+      negative multiplied) BEFORE greedy/sampling; reported logprobs
+      stay raw-model.
+
+    Returns (B, S + max_new_tokens) int32 ids (prompt included; padding
+    preserved as given).  With ``with_logprobs=True`` (static — a
+    second program variant) returns ``(ids, logprobs)`` where logprobs
+    is (B, max_new_tokens) f32: the RAW-model log-probability of each
+    emitted token (log_softmax of the unfiltered, untempered logits —
+    the serving-API convention, so values are comparable across
+    sampling settings); rows already past EOS report 0.0.
+    """
+    prompt = prompt.astype(jnp.int32)
+    b, s = prompt.shape
+    if max_new_tokens <= 0:
+        if with_logprobs:
+            return prompt, jnp.zeros((b, 0), jnp.float32)
+        return prompt
+    total = s + max_new_tokens
+    cache = init_cache(model, b, total)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    fixed, apply_model = prep_decode_variables(
+        model, variables, quant_kernel, weights_dtype
+    )
+
+    def model_vars(cache):
+        return {**fixed, "cache": cache}
 
     if prompt_mask is not None:
         pm = prompt_mask.astype(jnp.bool_)

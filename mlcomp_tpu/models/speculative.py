@@ -1,0 +1,207 @@
+"""Speculative decoding with n-gram (prompt-lookup) drafting.
+
+Single-sequence decode is HBM-bandwidth-bound: every token reads every
+weight byte once, so a B=1 step costs the same whether it scores 1 or
+K+1 positions (BENCH r4: b1 bf16 runs at 285 of a 317 tok/s weight-
+bytes roofline).  Speculative decoding turns that slack into tokens: a
+cheap DRAFT proposes K continuations, the target model scores all K+1
+positions in ONE forward (a chunked-decode pass — the same ``s>1,
+cache_index>0`` path chunked prefill uses, models/transformer.py
+``_decode_attention``), and the longest agreeing prefix is accepted.
+Under greedy decoding acceptance-or-resample degenerates to exact token
+comparison, so the output distribution is the target model's own greedy
+stream no matter how bad the draft is — a wrong draft only wastes the
+slack, never correctness.  One honest caveat on "exact": the verify
+forward (s=K+1) and ``generate``'s single-token step are DIFFERENT
+compiled programs, and XLA/Pallas do not promise bitwise-equal logits
+across program shapes — a step whose top-1/top-2 margin sits below
+that cross-program float noise can emit a different (equally-argmax)
+token, exactly as a batched-vs-unbatched comparison can.  The f32 test
+fixtures pin token-for-token equality (margins dwarf the noise); on
+bf16 checkpoints rare low-margin steps may flip, which changes the
+text but not its quality — every emitted token is still the argmax of
+target logits computed on its true prefix.
+
+The draft here is n-gram PROMPT-LOOKUP (no draft model, no training):
+propose the K tokens that followed the most recent earlier occurrence
+of the current bigram in the sequence so far.  On natural/structured
+text (code, JSON, chat with quoting — and any text with local
+repetition) bigram continuation hits often; on adversarially random
+tokens it simply never accepts and the loop degrades to ~vanilla speed.
+
+TPU-first shape discipline: the verify step is ONE compiled program
+(static K+1 width), the whole decode loop is a ``lax.while_loop`` on
+device (zero host round-trips), the ids buffer and KV cache are fixed
+allocations, and acceptance REWINDS ``cache_index`` (a scalar tree
+edit) instead of copying cache state — rejected slots are overwritten
+by the next verify before any mask admits them.  Composes with both
+KV-cache modes (bf16 and ``kv_quant`` int8 — the verify hits the
+quant path's chunked branch) and with int8 weights (``quant_kernel``
+via the same interception ``generate`` uses).
+
+No upstream analog (the reference has no generative path; SURVEY §2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.models.generation import init_cache, prep_decode_variables
+
+
+def ngram_propose(ids, cur, tok0, spec_k: int, pad_id: int = 0):
+    """Propose ``spec_k`` draft tokens by bigram prompt-lookup.
+
+    ``ids`` (T,) int32: prompt + accepted tokens, pads beyond ``cur``.
+    ``cur`` (): count of real tokens in ``ids``.  ``tok0`` (): the token
+    about to be appended (already sampled; not yet written).
+
+    Finds the LATEST position p with ``ids[p] == ids[cur-1] and
+    ids[p+1] == tok0`` strictly in the past, and proposes the tokens
+    that followed it.  No match → proposes ``pad_id`` repeats (they
+    will simply be rejected; correctness never depends on the draft).
+    """
+    t = ids.shape[0]
+    prev = ids[cur - 1]
+    idx = jnp.arange(t - 1, dtype=jnp.int32)
+    hit = (ids[:-1] == prev) & (ids[1:] == tok0) & (idx + 1 < cur)
+    # argmax of idx*hit = latest hit; score 0 rows collapse to "none"
+    score = jnp.where(hit, idx + 1, 0)
+    p = jnp.argmax(score).astype(jnp.int32)
+    found = score[p] > 0
+    src = jnp.clip(p + 2 + jnp.arange(spec_k, dtype=jnp.int32), 0, t - 1)
+    prop = ids[src]
+    # tokens at/after cur are pads/garbage, and a clip-shifted window
+    # would misalign: mask both to pad
+    prop = jnp.where((src < cur) & found, prop, jnp.int32(pad_id))
+    return prop
+
+
+def speculative_generate(
+    model,
+    variables: Dict[str, Any],
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    spec_k: int = 4,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    weights_dtype=None,
+    quant_kernel: bool = False,
+    with_stats: bool = False,
+):
+    """Greedy speculative decode of ``prompt`` (1, S) or (S,).
+
+    Returns (1, S + max_new_tokens) ids matching
+    ``generate(..., temperature=0)`` on the same weights (exactly in
+    the f32 test fixtures; up to cross-program float noise on
+    low-margin steps otherwise — see the module docstring).  With
+    ``with_stats=True`` returns ``(ids, stats)`` where stats carries
+    ``steps`` (verify forwards run) and ``emitted`` (tokens produced):
+    tokens-per-forward = emitted/steps is the acceptance speedup the
+    text admitted (1.0 = nothing accepted, K+1 = everything).
+
+    B=1 by design: speculation targets the latency-bound single-stream
+    case (throughput cases batch rows instead — the engine).  Greedy
+    only: sampled speculative decoding needs the rejection-sampling
+    correction; the greedy comparison IS that correction's T→0 limit.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    b, s = prompt.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative_generate is single-sequence (B=1), got B={b}; "
+            "batch throughput is the continuous engine's job"
+        )
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    n_new = int(max_new_tokens)
+    if n_new <= 0:
+        out = (prompt, {"steps": 0, "emitted": 0})
+        return out if with_stats else prompt
+    k = int(spec_k)
+    total = s + n_new
+    # verify may write up to K slots past the last emitted token; give
+    # the cache (not the ids buffer) that slack so writes stay in range
+    cache = init_cache(model, 1, total + k)
+    fixed, apply_model = prep_decode_variables(
+        model, variables, quant_kernel, weights_dtype
+    )
+
+    def set_cursor(cache, new_index):
+        """Rewind every layer's ``cache_index`` to the accepted depth —
+        stale K/V beyond it are overwritten by the next verify before
+        any slot mask admits them (slots <= q_slot)."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: (
+                jnp.asarray(new_index, leaf.dtype)
+                if path[-1].key == "cache_index" else leaf
+            ),
+            cache,
+        )
+
+    # ---- prefill: identical to generate's (B=1: no pads, no kv_mask)
+    positions = jnp.arange(s, dtype=jnp.int32)[None]
+    logits, upd = apply_model(
+        {**fixed, "cache": cache}, prompt, decode=True,
+        positions=positions, mutable=["cache"],
+    )
+    cache = upd["cache"]
+    last_logits = logits[0, -1].astype(jnp.float32)
+
+    ids0 = jnp.concatenate(
+        [prompt[0], jnp.full((n_new,), pad_id, jnp.int32)]
+    )
+
+    def cond(carry):
+        _, _, _, emitted, done, _ = carry
+        return (~done) & (emitted < n_new)
+
+    def body(carry):
+        cache, last_logits, ids, emitted, done, steps = carry
+        cur = s + emitted
+        tok0 = jnp.argmax(last_logits).astype(jnp.int32)
+        prop = ngram_propose(ids, cur, tok0, k, pad_id)
+        seq = jnp.concatenate([tok0[None], prop])          # (K+1,)
+        pos = cur + jnp.arange(k + 1, dtype=jnp.int32)
+        logits_v, upd = apply_model(
+            {**fixed, "cache": set_cursor(cache, cur)}, seq[None],
+            decode=True, positions=pos[None], mutable=["cache"],
+        )
+        lg = logits_v[0].astype(jnp.float32)               # (K+1, V)
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # g_1..g_{K+1}
+        ok = prop == greedy[:k]
+        accepted = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+        e = jnp.minimum(accepted + 1, n_new - emitted)     # emit cap
+        if eos_id is not None:
+            j = jnp.arange(k + 1, dtype=jnp.int32)
+            eos_hit = (seq == eos_id) & (j < e)
+            any_eos = jnp.any(eos_hit)
+            first = jnp.argmax(eos_hit).astype(jnp.int32)
+            e = jnp.where(any_eos, jnp.minimum(e, first + 1), e)
+            done = done | any_eos
+        # write the accepted prefix into the ids buffer (drop-mode set:
+        # the K+1-wide write may poke past the buffer at the budget end)
+        slots = cur + jnp.arange(k + 1, dtype=jnp.int32)
+        vals = jnp.where(
+            jnp.arange(k + 1) < e, seq,
+            ids.at[jnp.clip(slots, 0, total - 1)].get()
+        )
+        ids = ids.at[slots].set(vals, mode="drop")
+        # next round continues from the last ACCEPTED position's logits
+        last_logits = lg[jnp.maximum(e - 1, 0)]
+        cache = set_cursor(upd["cache"], cur + e)
+        return (cache, last_logits, ids, emitted + e, done, steps + 1)
+
+    carry = (cache, last_logits, ids0, jnp.int32(0),
+             jnp.zeros((), jnp.bool_), jnp.int32(0))
+    _, _, ids, emitted, _, steps = jax.lax.while_loop(cond, body, carry)
+    out = ids[None]
+    if with_stats:
+        return out, {"steps": steps, "emitted": emitted}
+    return out

@@ -1,0 +1,150 @@
+"""Speculative decoding (models/speculative.py): greedy-exactness vs
+``generate`` across KV/weight modes, the n-gram proposer, eos and
+budget handling, and acceptance accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlcomp_tpu.models import create_model
+from mlcomp_tpu.models.generation import generate
+from mlcomp_tpu.models.speculative import ngram_propose, speculative_generate
+from mlcomp_tpu.train.state import init_model
+
+
+def _lm(**kw):
+    cfg = {
+        "name": "transformer_lm", "vocab_size": 96, "hidden": 128,
+        "layers": 2, "heads": 2, "mlp_dim": 256, "dtype": "float32",
+    }
+    cfg.update(kw)
+    return create_model(cfg)
+
+
+def _vars(model, s=8, seed=0):
+    prompt = jnp.ones((1, s), jnp.int32)
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(seed))
+    return {"params": params}
+
+
+def test_ngram_propose_lookup_and_fallback():
+    ids = jnp.asarray([5, 7, 9, 3, 5, 7, 2, 4, 0, 0], jnp.int32)
+    # real tokens = ids[:6] = [5,7,9,3,5,7]; context bigram = (prev=7,
+    # tok0=9), which occurred at p=1 -> propose what followed: [3, 5, 7]
+    prop = ngram_propose(ids, jnp.int32(6), jnp.int32(9), 3)
+    np.testing.assert_array_equal(np.asarray(prop), [3, 5, 7])
+    # no such bigram anywhere: all-pad proposal
+    prop2 = ngram_propose(ids, jnp.int32(6), jnp.int32(77), 3, pad_id=0)
+    np.testing.assert_array_equal(np.asarray(prop2), [0, 0, 0])
+    # bigram (7, 2) occurs at p=5 but its continuation starts at
+    # p+2=7 >= cur... with cur=7 the continuation [4, pad...] clips:
+    # in-past source token kept, past-cur tail masked to pad
+    prop3 = ngram_propose(ids, jnp.int32(8), jnp.int32(2), 4)
+    # cur=8: real = [5,7,9,3,5,7,2,4]; prev=ids[7]=4, tok0=2: bigram
+    # (4, 2) never occurs -> pads
+    np.testing.assert_array_equal(np.asarray(prop3), [0, 0, 0, 0])
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_speculative_matches_generate_greedy(kv_quant):
+    model = _lm(kv_quant=kv_quant)
+    variables = _vars(model)
+    rs = np.random.RandomState(2)
+    for trial in range(3):
+        prompt = jnp.asarray(rs.randint(1, 96, (1, 8)))
+        ref = generate(model, variables, prompt, 12)
+        out = speculative_generate(
+            model, variables, prompt, 12, spec_k=4
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(ref),
+            err_msg=f"kv_quant={kv_quant} trial={trial}",
+        )
+
+
+def test_speculative_matches_generate_int8_kernel():
+    from mlcomp_tpu.ops.quant import quantize_params
+
+    model = _lm(hidden=256, mlp_dim=512, vocab_size=128)
+    prompt = jnp.asarray(np.random.RandomState(3).randint(1, 128, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    q = {"params": quantize_params(params, min_size=1024)}
+    ref = generate(model, q, prompt, 10, quant_kernel=True)
+    out = speculative_generate(
+        model, q, prompt, 10, spec_k=3, quant_kernel=True
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_budget_smaller_than_k():
+    model = _lm()
+    variables = _vars(model)
+    prompt = jnp.asarray(np.random.RandomState(5).randint(1, 96, (1, 6)))
+    ref = generate(model, variables, prompt, 2)
+    out = speculative_generate(model, variables, prompt, 2, spec_k=6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert out.shape == (1, 8)
+
+
+def test_speculative_eos_matches_generate():
+    model = _lm()
+    variables = _vars(model)
+    prompt = jnp.asarray(np.random.RandomState(7).randint(1, 96, (1, 6)))
+    free = np.asarray(generate(model, variables, prompt, 12))[0, 6:]
+    eos = int(free[4])  # force an eos hit mid-stream
+    ref = generate(model, variables, prompt, 12, eos_id=eos)
+    out = speculative_generate(
+        model, variables, prompt, 12, spec_k=4, eos_id=eos
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_stats_and_acceptance_on_repetitive_text():
+    """Acceptance accounting: steps/emitted come back; a greedy loop
+    that settles into a cycle (typical for random weights) must yield
+    tokens-per-forward >= 1 and the repetitive structure should let the
+    bigram draft accept SOMETHING across trials."""
+    model = _lm()
+    variables = _vars(model)
+    prompt = jnp.asarray(
+        np.tile(np.asarray([11, 23, 42, 11, 23, 42, 11, 23], np.int32),
+                (1, 1))
+    )
+    out, stats = speculative_generate(
+        model, variables, prompt, 24, spec_k=4, with_stats=True
+    )
+    emitted, steps = int(stats["emitted"]), int(stats["steps"])
+    assert emitted == 24
+    assert 1 <= steps <= emitted
+    ref = generate(model, variables, prompt, 24)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_rejects_batches_and_bad_args():
+    model = _lm()
+    variables = _vars(model)
+    with pytest.raises(ValueError, match="single-sequence"):
+        speculative_generate(
+            model, variables, jnp.ones((2, 4), jnp.int32), 4
+        )
+    with pytest.raises(ValueError, match="spec_k"):
+        speculative_generate(
+            model, variables, jnp.ones((1, 4), jnp.int32), 4, spec_k=0
+        )
+
+
+def test_speculative_1d_prompt_and_jit():
+    """(S,) prompts are accepted, and the whole function jits (the
+    production wrapper) with identical output."""
+    model = _lm()
+    variables = _vars(model)
+    prompt = jnp.asarray(np.random.RandomState(9).randint(1, 96, (6,)))
+    out = speculative_generate(model, variables, prompt, 8, spec_k=3)
+    jitted = jax.jit(
+        lambda v, p: speculative_generate(model, v, p, 8, spec_k=3)
+    )
+    out2 = jitted(variables, prompt)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    ref = generate(model, variables, prompt[None], 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
