@@ -356,6 +356,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         batcher=args.batcher,
         steps_per_dispatch=args.steps_per_dispatch,
         prefill_chunk=args.prefill_chunk,
+        spec_k=args.spec_k,
     )
     if args.warmup:
         n = service.warmup()
@@ -560,13 +561,21 @@ def main(argv=None) -> int:
     )
     sv.add_argument(
         "--batcher", default="auto",
-        choices=("auto", "continuous", "window"),
+        choices=("auto", "continuous", "window", "speculative"),
         help="'continuous' (the default, mesh or not): fixed decode"
         " slots, requests join a running decode at a dispatch"
         " boundary, finished rows free their slot, tokens stream"
         " (POST /generate with \"stream\": true -> SSE).  'window':"
         " the request-granularity batcher (one generate per arrival"
-        " window — offline batch generation)",
+        " window — offline batch generation).  'speculative': B=1"
+        " latency mode — each request runs the device-resident"
+        " n-gram speculative loop (greedy-only, single-chip; see"
+        " --spec-k)",
+    )
+    sv.add_argument(
+        "--spec-k", type=int, default=8,
+        help="speculative batcher: draft tokens per verify forward —"
+        " accepted drafts are nearly free on weight-bound B=1 decode",
     )
     sv.add_argument(
         "--steps-per-dispatch", type=int, default=4,

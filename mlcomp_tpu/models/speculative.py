@@ -52,12 +52,15 @@ import jax.numpy as jnp
 from mlcomp_tpu.models.generation import init_cache, prep_decode_variables
 
 
-def ngram_propose(ids, cur, tok0, spec_k: int, pad_id: int = 0):
+def ngram_propose(ids, cur, tok0, spec_k: int, pad_id: int = 0, start=0):
     """Propose ``spec_k`` draft tokens by bigram prompt-lookup.
 
-    ``ids`` (T,) int32: prompt + accepted tokens, pads beyond ``cur``.
-    ``cur`` (): count of real tokens in ``ids``.  ``tok0`` (): the token
-    about to be appended (already sampled; not yet written).
+    ``ids`` (T,) int32: [left-pads,] prompt + accepted tokens, pads
+    beyond ``cur``.  ``cur`` (): buffer slots filled so far (pads +
+    real).  ``tok0`` (): the token about to be appended (already
+    sampled; not yet written).  ``start`` (): first REAL slot (the
+    left-pad count in the serving bucket contract) — earlier slots
+    never match.
 
     Finds the LATEST position p with ``ids[p] == ids[cur-1] and
     ids[p+1] == tok0`` strictly in the past, and proposes the tokens
@@ -67,7 +70,8 @@ def ngram_propose(ids, cur, tok0, spec_k: int, pad_id: int = 0):
     t = ids.shape[0]
     prev = ids[cur - 1]
     idx = jnp.arange(t - 1, dtype=jnp.int32)
-    hit = (ids[:-1] == prev) & (ids[1:] == tok0) & (idx + 1 < cur)
+    hit = (ids[:-1] == prev) & (ids[1:] == tok0) & (idx + 1 < cur) \
+        & (idx >= start)
     # argmax of idx*hit = latest hit; score 0 rows collapse to "none"
     score = jnp.where(hit, idx + 1, 0)
     p = jnp.argmax(score).astype(jnp.int32)
@@ -86,6 +90,7 @@ def speculative_generate(
     prompt: jax.Array,
     max_new_tokens: int,
     *,
+    prompt_mask: Optional[jax.Array] = None,
     spec_k: int = 4,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
@@ -103,6 +108,11 @@ def speculative_generate(
     ``steps`` (verify forwards run) and ``emitted`` (tokens produced):
     tokens-per-forward = emitted/steps is the acceptance speedup the
     text admitted (1.0 = nothing accepted, K+1 = everything).
+
+    ``prompt_mask`` (1, S) or (S,): True on real tokens, False on
+    LEFT-padding — the serving bucket contract, same as ``generate``:
+    pad slots never attend, RoPE positions count from the first real
+    token, and the n-gram proposer never matches into the pad prefix.
 
     B=1 by design: speculation targets the latency-bound single-stream
     case (throughput cases batch rows instead — the engine).  Greedy
@@ -145,11 +155,24 @@ def speculative_generate(
             cache,
         )
 
-    # ---- prefill: identical to generate's (B=1: no pads, no kv_mask)
-    positions = jnp.arange(s, dtype=jnp.int32)[None]
+    # ---- prefill: identical to generate's (LEFT-pad contract when a
+    # mask rides along — the serving bucket path)
+    if prompt_mask is not None:
+        pm = jnp.asarray(prompt_mask, jnp.bool_).reshape(1, s)
+        positions = jnp.maximum(
+            jnp.cumsum(pm, axis=1) - 1, 0
+        ).astype(jnp.int32)
+        start = jnp.argmax(pm[0].astype(jnp.int32)).astype(jnp.int32)
+        kv_mask = jnp.concatenate(
+            [pm, jnp.ones((1, total + k - s), jnp.bool_)], axis=1
+        )
+    else:
+        positions = jnp.arange(s, dtype=jnp.int32)[None]
+        start = jnp.int32(0)
+        kv_mask = None
     logits, upd = apply_model(
         {**fixed, "cache": cache}, prompt, decode=True,
-        positions=positions, mutable=["cache"],
+        positions=positions, kv_mask=kv_mask, mutable=["cache"],
     )
     cache = upd["cache"]
     last_logits = logits[0, -1].astype(jnp.float32)
@@ -166,12 +189,15 @@ def speculative_generate(
         cache, last_logits, ids, emitted, done, steps = carry
         cur = s + emitted
         tok0 = jnp.argmax(last_logits).astype(jnp.int32)
-        prop = ngram_propose(ids, cur, tok0, k, pad_id)
+        prop = ngram_propose(ids, cur, tok0, k, pad_id, start=start)
         seq = jnp.concatenate([tok0[None], prop])          # (K+1,)
-        pos = cur + jnp.arange(k + 1, dtype=jnp.int32)
+        # RoPE positions are REAL-token counts: buffer slot minus the
+        # pad prefix (start == 0 without a mask)
+        pos = cur - start + jnp.arange(k + 1, dtype=jnp.int32)
         logits_v, upd = apply_model(
             {**fixed, "cache": set_cursor(cache, cur)}, seq[None],
-            decode=True, positions=pos[None], mutable=["cache"],
+            decode=True, positions=pos[None], kv_mask=kv_mask,
+            mutable=["cache"],
         )
         lg = logits_v[0].astype(jnp.float32)               # (K+1, V)
         greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # g_1..g_{K+1}

@@ -277,9 +277,12 @@ class SelfAttention(nn.Module):
         ``kv_start = argmax(kv_mask)`` is exact).  Prefill attends the
         fresh bf16 K/V directly — ragged batches stay on the flash
         kernel via ``kv_start`` windows instead of dropping to a dense
-        mask like the bf16 cache path.  Chunked prefill (i > 0, s > 1)
-        dequantizes the buffer in XLA — correct, one-off, and unused by
-        the stock generation loop.
+        mask like the bf16 cache path.  Chunked decode (i > 0, s > 1)
+        at verify widths (s <= CHUNK_MAX_SQ, single-chip) runs the
+        multi-query flash kernel (``decode_attention_chunk`` — one
+        int8 cache sweep for all s queries; the speculative verify
+        path); wider chunks and mesh serving dequantize the buffer in
+        XLA — correct, bandwidth-amortized at prefill widths.
         """
         from mlcomp_tpu.ops.pallas.decode_attention import (
             decode_attention,
@@ -470,6 +473,32 @@ class SelfAttention(nn.Module):
             return dot_product_attention(q, k, v, causal=True, kv_start=start)
 
         def chunked():
+            from mlcomp_tpu.ops.pallas.decode_attention import (
+                CHUNK_MAX_SQ,
+                decode_attention_chunk,
+            )
+            from mlcomp_tpu.ops.quant import pallas_mesh
+
+            # small chunks (the speculative verify shape) take the
+            # multi-query flash kernel: ONE sweep of the int8 cache for
+            # all s queries, dequant in VMEM — the XLA path below
+            # materializes a bf16 copy of the WHOLE buffer per forward
+            # (priced in the speculative bench: it ate the kv8 win).
+            # Wide prefill chunks keep the XLA path (the kernel's
+            # sublane packing is sized for verify widths), as does
+            # mesh serving (no sharded chunk wrapper yet).
+            if s <= CHUNK_MAX_SQ and pallas_mesh() is None:
+                qp = (
+                    jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+                    if dhp != dh else q
+                )
+                out = decode_attention_chunk(
+                    qp, ckq.value, cks.value, cvq.value, cvs.value,
+                    kv_start=start,
+                    kv_stop0=jnp.broadcast_to(i + 1, (b,)),
+                    scale=1.0 / (dh**0.5),
+                )
+                return out[..., :dh]
             k_scale = cks.value.transpose(0, 1, 3, 2)       # (B, Hkv, L, 1)
             v_scale = cvs.value.transpose(0, 1, 3, 2)
             k_all = (

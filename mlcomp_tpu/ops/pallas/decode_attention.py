@@ -292,6 +292,197 @@ def decode_attention(
     return out[:, :, :rep].reshape(b, h, dh)
 
 
+def _kernel_chunk(
+    start_ref, stop0_ref,  # scalar prefetch: (B,) int32 each
+    q_ref, k_ref, ks_ref, v_ref, vs_ref,
+    o_ref,
+    acc_ref, m_ref, l_ref,
+    *, scale: float, block_kv: int, rep: int, s_q: int,
+):
+    """Multi-query flash-decode: S query tokens per row in one pass over
+    the int8 cache (the speculative verify / small-chunk shape).
+
+    Query tokens ride the SUBLANE axis next to their GQA group —
+    row r = j * rep + g is query j, group head g — so the cache block
+    is read ONCE for all S queries (the whole point: a verify of K+1
+    tokens costs one cache sweep, not K+1).  Causality is per sublane
+    row: query j's window is [start, stop0 + j) where stop0 is query
+    0's exclusive stop (its own cache slot + 1)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    lo = start_ref[b]
+    stop0 = stop0_ref[b]
+    hi_max = stop0 + (s_q - 1)
+    live = (j * block_kv < hi_max) & ((j + 1) * block_kv > lo)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]                               # (Hkv, Sp, dh)
+        k = k_ref[0].astype(q.dtype)               # (Hkv, BLK, dh)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # (Hkv, Sp, BLK)
+        s = s * ks_ref[0].astype(jnp.float32)
+        cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        # per-sublane-row causal stop: row r is query r // rep (pad
+        # rows beyond s_q*rep just mask everything; their output is
+        # sliced away)
+        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // rep
+        s = jnp.where((cols >= lo) & (cols < stop0 + qrow), s, NEG_INF)
+
+        m_prev = m_ref[:, :, :1]
+        l_prev = l_ref[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        pv = (p * vs_ref[0].astype(jnp.float32)).astype(q.dtype)
+        v = v_ref[0].astype(q.dtype)                # (Hkv, BLK, dh)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pv, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, :, :1]
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
+
+
+# sublane budget for the multi-query kernel's (Hkv, Sp, dh) f32
+# scratch triple — S (chunk width) beyond this stays on the XLA
+# dequant path (big prefill chunks are bandwidth-amortized there
+# anyway; the kernel's value is the SMALL verify shape)
+CHUNK_MAX_SQ = 32
+
+
+def decode_attention_chunk(
+    q: jax.Array,
+    k8: jax.Array,
+    ks: jax.Array,
+    v8: jax.Array,
+    vs: jax.Array,
+    kv_start: Optional[jax.Array] = None,
+    kv_stop0: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Multi-query attention against an int8 KV cache: S chunk tokens
+    per row in ONE sweep of the cache.
+
+    q: (B, S, H, dh) chunk queries whose K/V are ALREADY written to the
+    cache at slots [stop0-1+j for j in range(S)]... i.e. query j sits
+    at cache slot ``kv_stop0 - 1 + j`` and attends [kv_start,
+    kv_stop0 + j).  The speculative verify and small chunked-decode
+    shape (models/speculative.py; transformer._decode_attention_quant
+    routes here for S <= CHUNK_MAX_SQ).  The single-token kernel is the
+    S == 1 special case (kv_stop0 == its kv_stop).
+
+    Layout and masking follow :func:`decode_attention`; the only new
+    machinery is the per-sublane causal stop.  Returns (B, S, H, dh).
+    """
+    b, s_q, h, dh = q.shape
+    _, h_kv, l_buf, _ = k8.shape
+    if ks.shape != (b, h_kv, 1, l_buf) or vs.shape != (b, h_kv, 1, l_buf):
+        raise ValueError(
+            f"scales must be (B, Hkv, 1, L) = {(b, h_kv, 1, l_buf)}; got "
+            f"ks {ks.shape}, vs {vs.shape}"
+        )
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    if s_q > CHUNK_MAX_SQ:
+        raise NotImplementedError(
+            f"chunk width {s_q} > {CHUNK_MAX_SQ}: the multi-query kernel "
+            "is sized for verify/small-chunk shapes; wider chunks take "
+            "the XLA dequant path"
+        )
+    if l_buf % LANES or dh % LANES:
+        raise NotImplementedError(
+            f"cache length {l_buf} and head dim {dh} must be multiples of "
+            f"{LANES} (allocator contract)"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    scale = scale if scale is not None else 1.0 / (dh**0.5)
+    blk = auto_block_kv(l_buf, h_kv, dh)
+    nk = l_buf // blk
+
+    rep = h // h_kv
+    rows = s_q * rep
+    sp = max(SUBLANES, -(-rows // SUBLANES) * SUBLANES)
+    # (B, S, H, dh) -> (B, Hkv, Sp, dh), sublane row r = query*rep + g:
+    # transpose the group axis next to the query axis, then flatten
+    qg = q.reshape(b, s_q, h_kv, rep, dh).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, h_kv, rows, dh)
+    if sp != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, sp - rows), (0, 0)))
+
+    start = (
+        jnp.zeros((b,), jnp.int32) if kv_start is None
+        else kv_start.astype(jnp.int32)
+    )
+    stop0 = (
+        jnp.full((b,), l_buf - s_q + 1, jnp.int32) if kv_stop0 is None
+        else jnp.broadcast_to(kv_stop0, (b,)).astype(jnp.int32)
+    )
+
+    def _clamp(b_, j, start_ref, stop0_ref):
+        lo_b = jnp.minimum(start_ref[b_] // blk, nk - 1)
+        hi_b = jnp.maximum(
+            (stop0_ref[b_] + (s_q - 1) - 1) // blk, lo_b
+        )
+        return jnp.clip(j, lo_b, hi_b)
+
+    def kvj(b_, j, start_ref, stop0_ref):
+        return (b_, 0, _clamp(b_, j, start_ref, stop0_ref), 0)
+
+    def ksj(b_, j, start_ref, stop0_ref):
+        return (b_, 0, 0, _clamp(b_, j, start_ref, stop0_ref))
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel_chunk, scale=scale, block_kv=blk, rep=rep, s_q=s_q
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, nk),
+            in_specs=[
+                pl.BlockSpec((1, h_kv, sp, dh), lambda b_, j, *_: (b_, 0, 0, 0)),
+                pl.BlockSpec((1, h_kv, blk, dh), kvj),
+                pl.BlockSpec((1, h_kv, 1, blk), ksj),
+                pl.BlockSpec((1, h_kv, blk, dh), kvj),
+                pl.BlockSpec((1, h_kv, 1, blk), ksj),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, h_kv, sp, dh), lambda b_, j, *_: (b_, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((h_kv, sp, dh), jnp.float32),
+                pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
+                pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, sp, dh), q.dtype),
+        interpret=interpret,
+    )(start, stop0, qg, k8, ks, v8, vs)
+    out = out[:, :, :rows].reshape(b, h_kv, s_q, rep, dh)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, dh)
+
+
 def sharded_decode_attention(
     q: jax.Array,
     k8: jax.Array,

@@ -75,6 +75,18 @@ def _bucket(value: int, buckets: Sequence[int], what: str) -> int:
     )
 
 
+def _trim_generated(row: np.ndarray, s_bucket: int,
+                    item: Dict[str, Any]) -> List[int]:
+    """Request-visible ids from a full output row: drop the bucketed
+    prompt, cap at the request's n_new, trim pads after EOS.  The one
+    post-processing contract every batcher shares."""
+    gen = row[s_bucket:s_bucket + item["n_new"]].tolist()
+    eos = item.get("eos_id", -1)
+    if eos >= 0 and eos in gen:
+        gen = gen[: gen.index(eos) + 1]
+    return gen
+
+
 def left_pad_row(ids: Sequence[int], s_bucket: int, pad_id: int):
     """The serving LEFT-padding contract, in one place (window batcher
     rows and the continuous engine's prefill share it): returns the
@@ -114,6 +126,7 @@ class GenerationService:
         batcher: str = "auto",
         steps_per_dispatch: int = 4,
         prefill_chunk: int = 256,
+        spec_k: int = 8,
     ):
         import jax
 
@@ -198,7 +211,10 @@ class GenerationService:
                 self.knobs["quant_kernel"] = True
         self.variables = variables
         self._rng = jax.random.PRNGKey(seed)
-        self._fns: Dict[Tuple[int, int, int], Any] = {}
+        # window keys are (b, s, n_new) int triples; the speculative
+        # batcher uses ("spec", s, n_new) — the two never coexist in
+        # one service (stats() sorts the keys, which would mix types)
+        self._fns: Dict[Tuple[Any, ...], Any] = {}
         self._queue: "queue.Queue" = queue.Queue()
         self._deferred: List[Dict[str, Any]] = []  # batcher thread only
         self._stats = {"requests": 0, "batches": 0, "batched_rows": 0}
@@ -213,14 +229,46 @@ class GenerationService:
         # round-3 request-granularity batcher: one generate() per
         # arrival window — zero per-token dispatches, the right tool
         # for offline batch generation.
+        # "speculative" (round 5) = B=1 latency mode: each request runs
+        # the device-resident speculative loop (n-gram prompt-lookup
+        # draft + K+1-wide verify, models/speculative.py) — the right
+        # tool for a single interactive stream on repetitive text;
+        # greedy-only, single-chip, one request per program.
         if batcher == "auto":
             batcher = "continuous"
-        if batcher not in ("continuous", "window"):
+        if batcher not in ("continuous", "window", "speculative"):
             raise ValueError(
-                f"batcher: expected 'auto'/'continuous'/'window', "
-                f"got {batcher!r}"
+                f"batcher: expected 'auto'/'continuous'/'window'/"
+                f"'speculative', got {batcher!r}"
             )
         self.batcher = batcher
+        self.spec_k = int(spec_k)
+        if batcher == "speculative":
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if mesh is not None:
+                raise ValueError(
+                    "the speculative batcher is single-chip (B=1 latency "
+                    "mode); use the continuous batcher under a mesh"
+                )
+            if self.defaults["temperature"] != 0.0:
+                raise ValueError(
+                    "the speculative batcher is greedy-only; set the "
+                    "service default temperature to 0"
+                )
+            if self.defaults["repetition_penalty"] != 1.0:
+                # reject at construction like temperature: otherwise
+                # every defaults-only request fails at submit blaming
+                # a knob the client never passed
+                raise ValueError(
+                    "repetition_penalty is not supported by the "
+                    "speculative batcher; drop the service default"
+                )
+            # one request per program — B=1 by design (throughput cases
+            # want the continuous engine); requests never co-batch
+            self.batch_sizes = (1,)
+            self._stats["spec_tokens"] = 0
+            self._stats["spec_forwards"] = 0
         if batcher == "continuous":
             from mlcomp_tpu.engine import DecodeEngine
 
@@ -326,6 +374,25 @@ class GenerationService:
         # request errors, not batcher crashes
         _bucket(len(ids), self.prompt_buckets, "prompt length")
         nb = _bucket(n_new, self.max_new_buckets, "max_new_tokens")
+        if self.batcher == "speculative":
+            # the device-resident speculative loop is greedy-only and
+            # emits no per-token host boundaries to stream or score at
+            if t != 0.0:
+                raise ValueError(
+                    "the speculative batcher is greedy-only "
+                    "(temperature 0); use the continuous batcher for "
+                    "sampling"
+                )
+            if rp != 1.0:
+                raise ValueError(
+                    "repetition_penalty is not supported by the "
+                    "speculative batcher"
+                )
+            if logprobs:
+                raise ValueError(
+                    "logprobs are not supported by the speculative "
+                    "batcher"
+                )
         if self.engine is not None:
             # the engine counts its own requests (stats() surfaces that
             # count as the service total) — incrementing here too would
@@ -337,7 +404,7 @@ class GenerationService:
         if stream is not None:
             raise ValueError(
                 "token streaming needs the continuous batcher; this "
-                "service runs the window batcher"
+                f"service runs the {self.batcher} batcher"
             )
         self._stats["requests"] += 1
         fut: Future = Future()
@@ -375,6 +442,20 @@ class GenerationService:
             for f in futs:
                 f.result(timeout=600)
             return len(futs)
+        if self.batcher == "speculative":
+            import jax.numpy as jnp
+
+            n = 0
+            for s in self.prompt_buckets:
+                for nb in self.max_new_buckets:
+                    row, mask = left_pad_row([1], s, self.pad_id)
+                    out, _ = self._get_spec_fn(s, nb)(
+                        self.variables, jnp.asarray(row[None]),
+                        jnp.asarray(mask[None]), jnp.int32(-1),
+                    )
+                    int(out[0, -1])
+                    n += 1
+            return n
         n = 0
         s = self.prompt_buckets[-1]
         # smallest + largest SERVABLE batch (1 may not be a bucket
@@ -574,7 +655,10 @@ class GenerationService:
                 if not batch:
                     continue
                 try:
-                    self._run_batch(batch)
+                    if self.batcher == "speculative":
+                        self._run_spec(batch[0])  # batch_sizes == (1,)
+                    else:
+                        self._run_batch(batch)
                 except Exception as e:  # surface to the waiting requests
                     for item in batch:
                         if not item["future"].done():
@@ -593,6 +677,54 @@ class GenerationService:
                 except queue.Empty:
                     break
                 _fail_future(item["future"], err)
+
+    def _get_spec_fn(self, s_bucket: int, n_bucket: int):
+        import jax
+
+        from mlcomp_tpu.models.speculative import speculative_generate
+
+        key = ("spec", s_bucket, n_bucket)
+        if key not in self._fns:
+            def run(variables, prompt, mask, eos):
+                # eos rides TRACED (-1 = none: no vocab id matches), so
+                # one program per (prompt, new) bucket serves every
+                # request; the budget is the bucket (static shape), the
+                # host trims to the request's n_new like _run_batch
+                return speculative_generate(
+                    self.model, variables, prompt, n_bucket,
+                    prompt_mask=mask, spec_k=self.spec_k, eos_id=eos,
+                    pad_id=self.pad_id,
+                    quant_kernel=bool(self.knobs.get("quant_kernel")),
+                    with_stats=True,
+                )
+
+            self._fns[key] = jax.jit(run)
+        return self._fns[key]
+
+    def _run_spec(self, item: Dict[str, Any]) -> None:
+        """One request through the device-resident speculative loop
+        (speculative batcher): prefill + ngram-draft + K+1-wide verify
+        entirely on device — a single dispatch per request."""
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        s_bucket = _bucket(len(item["ids"]), self.prompt_buckets, "prompt")
+        row, mask = left_pad_row(item["ids"], s_bucket, self.pad_id)
+        fn = self._get_spec_fn(s_bucket, item["bucket_new"])
+        out, stats = fn(
+            self.variables, jnp.asarray(row[None]), jnp.asarray(mask[None]),
+            jnp.int32(item.get("eos_id", -1)),
+        )
+        gen = _trim_generated(np.asarray(out)[0], s_bucket, item)
+        self._stats["batches"] += 1
+        self._stats["batched_rows"] += 1
+        self._stats["spec_tokens"] += int(stats["emitted"])
+        self._stats["spec_forwards"] += int(stats["steps"])
+        item["future"].set_result({
+            "ids": gen,
+            "latency_ms": round((time.perf_counter() - t0) * 1e3, 2),
+            "batched_with": 1,
+        })
 
     def _run_batch(self, batch: List[Dict[str, Any]]) -> None:
         import jax
@@ -638,10 +770,7 @@ class GenerationService:
         self._stats["batches"] += 1
         self._stats["batched_rows"] += len(batch)
         for r, item in enumerate(batch):
-            gen = out[r, s_bucket:s_bucket + item["n_new"]].tolist()
-            eos = item.get("eos_id", -1)
-            if eos >= 0 and eos in gen:
-                gen = gen[: gen.index(eos) + 1]  # pads after EOS trimmed
+            gen = _trim_generated(out[r], s_bucket, item)
             result = {"ids": gen, "latency_ms": round(latency_ms, 2),
                       "batched_with": len(batch)}
             if item.get("logprobs"):

@@ -39,3 +39,17 @@ jax.config.update("jax_platforms", "cpu")
 @pytest.fixture()
 def tmp_db(tmp_path):
     return str(tmp_path / "mlcomp.sqlite")
+
+
+@pytest.fixture(autouse=True)
+def _clear_process_mesh():
+    """The installed mesh is a process-wide global (production installs
+    it once per Trainer/service lifetime); tests that install one and
+    don't clean up would silently flip OTHER tests onto mesh-gated
+    paths (sharded kernel islands, fold_norms disabled, the chunk
+    kernel's XLA fallback) — the round-5 full-suite run caught exactly
+    that. Every test starts and ends mesh-free."""
+    yield
+    from mlcomp_tpu.parallel.mesh import set_current_mesh
+
+    set_current_mesh(None)

@@ -73,6 +73,90 @@ def test_decode_kernel_matches_reference(h, h_kv, dh):
     assert np.abs(np.asarray(out[3])).max() == 0.0  # empty window: zeros
 
 
+@pytest.mark.parametrize("h,h_kv,dh,s_q", [
+    (8, 8, 128, 5), (8, 2, 128, 9), (4, 1, 64, 3), (4, 4, 128, 1),
+])
+def test_chunk_kernel_matches_reference(h, h_kv, dh, s_q):
+    """Multi-query kernel vs a dequant reference with per-query causal
+    stops: query j attends [start, stop0 + j)."""
+    from mlcomp_tpu.ops.pallas.decode_attention import (
+        decode_attention_chunk,
+    )
+
+    rng = np.random.default_rng(1)
+    b, l_buf = 3, 256
+    dhp = max(dh, 128)
+    q = jnp.asarray(rng.normal(size=(b, s_q, h, dhp)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h_kv, l_buf, dhp)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h_kv, l_buf, dhp)), jnp.float32)
+    if dhp != dh:
+        q = q.at[..., dh:].set(0.0)
+        k = k.at[..., dh:].set(0.0)
+        v = v.at[..., dh:].set(0.0)
+    k8, ks = quantize_kv(k)
+    v8, vs = quantize_kv(v)
+    start = jnp.asarray([0, 17, 40], jnp.int32)
+    stop0 = jnp.asarray([200, 60, 41], jnp.int32)  # incl. a 1-slot row
+    scale = 1.0 / (dh**0.5)
+    out = decode_attention_chunk(
+        q, k8, ks[:, :, None, :], v8, vs[:, :, None, :],
+        kv_start=start, kv_stop0=stop0, scale=scale,
+    )
+    # reference: S independent single-token calls at growing stops
+    refs = []
+    for j in range(s_q):
+        refs.append(_reference(
+            q[:, j], k8, ks, v8, vs, start, stop0 + j, scale
+        ))
+    ref = jnp.stack(refs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-2
+    )
+
+
+def test_chunk_kernel_agrees_with_single_token_kernel():
+    """S == 1 chunk must match decode_attention exactly (same math,
+    same block walk)."""
+    from mlcomp_tpu.ops.pallas.decode_attention import (
+        decode_attention_chunk,
+    )
+
+    rng = np.random.default_rng(2)
+    b, h, h_kv, dh, l_buf = 2, 8, 4, 128, 256
+    q = jnp.asarray(rng.normal(size=(b, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h_kv, l_buf, dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h_kv, l_buf, dh)), jnp.float32)
+    k8, ks = quantize_kv(k)
+    v8, vs = quantize_kv(v)
+    start = jnp.asarray([0, 11], jnp.int32)
+    stop = jnp.asarray([97, 64], jnp.int32)
+    a = decode_attention(
+        q, k8, ks[:, :, None, :], v8, vs[:, :, None, :],
+        kv_start=start, kv_stop=stop,
+    )
+    c = decode_attention_chunk(
+        q[:, None], k8, ks[:, :, None, :], v8, vs[:, :, None, :],
+        kv_start=start, kv_stop0=stop,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a), np.asarray(c[:, 0]), atol=1e-5
+    )
+
+
+def test_chunk_kernel_rejects_wide_chunks():
+    from mlcomp_tpu.ops.pallas.decode_attention import (
+        CHUNK_MAX_SQ,
+        decode_attention_chunk,
+    )
+
+    b, h, dh, l_buf = 1, 4, 128, 256
+    q = jnp.zeros((b, CHUNK_MAX_SQ + 1, h, dh))
+    k8 = jnp.zeros((b, h, l_buf, dh), jnp.int8)
+    sc = jnp.zeros((b, h, 1, l_buf))
+    with pytest.raises(NotImplementedError, match="chunk width"):
+        decode_attention_chunk(q, k8, sc, k8, sc)
+
+
 def test_decode_kernel_rejects_bad_scale_shape():
     q = jnp.zeros((1, 4, 128))
     k8 = jnp.zeros((1, 4, 128, 128), jnp.int8)

@@ -121,6 +121,34 @@ def test_speculative_stats_and_acceptance_on_repetitive_text():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_speculative_prompt_mask_matches_generate():
+    """The LEFT-pad serving bucket contract: a padded prompt + mask
+    produces the same generated tail as both generate-with-mask and
+    the unpadded speculative run."""
+    model = _lm()
+    variables = _vars(model)
+    rs = np.random.RandomState(11)
+    real = rs.randint(1, 96, 5)
+    s_bucket = 12
+    row = np.zeros(s_bucket, np.int64)
+    row[-5:] = real
+    mask = np.zeros(s_bucket, bool)
+    mask[-5:] = True
+    prompt = jnp.asarray(row[None])
+    pm = jnp.asarray(mask[None])
+    ref = generate(model, variables, prompt, 10, prompt_mask=pm)
+    out = speculative_generate(
+        model, variables, prompt, 10, spec_k=4, prompt_mask=pm
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    out2 = speculative_generate(
+        model, variables, jnp.asarray(real[None]), 10, spec_k=4
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out)[:, s_bucket:], np.asarray(out2)[:, 5:]
+    )
+
+
 def test_speculative_rejects_batches_and_bad_args():
     model = _lm()
     variables = _vars(model)
