@@ -599,6 +599,55 @@ def bench_engine(scan_variants=None) -> None:
             round(scan_ms / step_ms, 4) if scan_ms else None
         ),
     }
+
+    # BATCHED speculative engine (round 5, opt-in spec_k): one
+    # per-row-cursor verify per dispatch — tokens/dispatch = 8 rows x
+    # acceptance.  Weights are untrained so acceptance is the
+    # cycle-prone ~1.2 (bench_speculative's fixture line is the
+    # realistic-text number); what THIS block prices is the verify
+    # dispatch cost next to the K-step scan dispatch above.  The
+    # tunnel overhead estimate reuses the non-spec engine's measured
+    # split (same one-call + one-fetch host path).
+    if os.environ.get("MLCOMP_BENCH_SKIP_ENGINE_SPEC", "") not in (
+        "1", "true"
+    ):
+        spec_eng = DecodeEngine(
+            model, qvars, slots=8, prompt_buckets=(DEC_PROMPT,),
+            max_new_cap=DEC_NEW, quant_kernel=True, spec_k=8,
+        )
+        spec_eng._stop.set()
+        spec_eng._queue.put(_POISON)
+        spec_eng._thread.join(timeout=30)
+        for _ in range(8):
+            spec_eng._start_admission(make_req(DEC_NEW))
+            while spec_eng._adm is not None:
+                spec_eng._run_admission_chunk()
+        spec_eng._run_dispatch()
+        spec_eng._run_dispatch()
+        # engine-level counter, not a slot sum: a row that finishes
+        # mid-window frees its slot and a slot sum would drop its tokens
+        emitted0 = spec_eng._stats["emitted_tokens"]
+        walls_s = []
+        n_disp = 3
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            for _ in range(n_disp):
+                spec_eng._run_dispatch()
+            walls_s.append((time.perf_counter() - t0) / n_disp)
+        emitted1 = spec_eng._stats["emitted_tokens"]
+        w_spec = statistics.median(walls_s)
+        toks_per_disp = (emitted1 - emitted0) / (WINDOWS * n_disp)
+        est_step = max(w_spec * 1e3 - overhead_ms, 1e-3)
+        line["engine_spec"] = {
+            "spec_k": 8,
+            "tokens_per_dispatch": round(toks_per_disp, 2),
+            "acceptance_tokens_per_row": round(toks_per_disp / 8, 2),
+            "dispatch_wall_ms": round(w_spec * 1e3, 3),
+            "verify_step_ms_est": round(est_step, 3),
+            "tokens_per_sec_marginal_est": round(
+                toks_per_disp / (est_step / 1e3), 1
+            ),
+        }
     print(json.dumps(line))
 
 

@@ -357,6 +357,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         steps_per_dispatch=args.steps_per_dispatch,
         prefill_chunk=args.prefill_chunk,
         spec_k=args.spec_k,
+        engine_spec_k=args.engine_spec_k,
     )
     if args.warmup:
         n = service.warmup()
@@ -576,6 +577,12 @@ def main(argv=None) -> int:
         "--spec-k", type=int, default=8,
         help="speculative batcher: draft tokens per verify forward —"
         " accepted drafts are nearly free on weight-bound B=1 decode",
+    )
+    sv.add_argument(
+        "--engine-spec-k", type=int, default=None,
+        help="continuous batcher: BATCHED speculative decoding — every"
+        " dispatch drafts + verifies K tokens per slot in one"
+        " per-row-cursor forward (greedy-only fleet; single-chip)",
     )
     sv.add_argument(
         "--steps-per-dispatch", type=int, default=4,

@@ -185,13 +185,14 @@ class SelfAttention(nn.Module):
         ``kv_mask`` (B, max_len) marks cache slots that are valid keys
         (False = left-padding in a ragged prompt batch).
 
-        ``cache_cursor`` (B,) int32 switches to PER-ROW write offsets
-        (single-token steps only): each row writes its K/V at its own
-        slot and attends slots <= its own cursor — the contract the
+        ``cache_cursor`` (B,) int32 switches to PER-ROW write offsets:
+        each row writes its K/V starting at its own slot and query j
+        attends slots <= cursor + j — the contract the
         continuous-batching engine (mlcomp_tpu/engine.py) drives, where
-        every row is at a different decode depth.  The module's scalar
-        ``cache_index`` is neither read nor advanced then (the engine
-        owns the cursors).
+        every row is at a different decode depth (s == 1 is the plain
+        decode step; s > 1 is the engine's speculative verify chunk).
+        The module's scalar ``cache_index`` is neither read nor
+        advanced then (the engine owns the cursors).
         """
         if self.kv_quant:
             return self._decode_attention_quant(
@@ -204,20 +205,29 @@ class SelfAttention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         if cache_cursor is not None:
-            if s != 1:
-                raise ValueError(
-                    "cache_cursor is a single-token-step contract (s=1); "
-                    f"got s={s}"
-                )
+            # per-row write offsets; s > 1 (round 5) is the engine's
+            # SPECULATIVE verify: row b's query j writes slot cur_b + j
+            # and attends slots <= cur_b + j (per-row causal chunk)
             cur = cache_cursor.astype(jnp.int32)
             rows = jnp.arange(b)
-            k_all = cached_k.value.at[rows, cur].set(k[:, 0])
-            v_all = cached_v.value.at[rows, cur].set(v[:, 0])
+            if s == 1:
+                k_all = cached_k.value.at[rows, cur].set(k[:, 0])
+                v_all = cached_v.value.at[rows, cur].set(v[:, 0])
+            else:
+                offs = cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+                k_all = cached_k.value.at[rows[:, None], offs].set(k)
+                v_all = cached_v.value.at[rows[:, None], offs].set(v)
             cached_k.value = k_all
             cached_v.value = v_all
             max_len = k_all.shape[1]
             slots = jnp.arange(max_len, dtype=jnp.int32)
-            mask = (slots[None, :] <= cur[:, None])[:, None, None]  # (B,1,1,L)
+            if s == 1:
+                mask = (slots[None, :] <= cur[:, None])[:, None, None]
+            else:  # (B, 1, S, L): per-row, per-query causal stops
+                stops = cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+                mask = (
+                    slots[None, None, None, :] <= stops[:, None, :, None]
+                )
             if kv_mask is not None:
                 mask = mask & kv_mask[:, None, None, :].astype(jnp.bool_)
             return dot_product_attention(q, k_all, v_all, mask=mask)
@@ -366,36 +376,87 @@ class SelfAttention(nn.Module):
                 )
             return out[..., :dh][:, None]
 
+        def chunk_attend(row_start, stop0):
+            """s>1 attention against the just-updated quant cache with
+            per-row per-query causal stops [row_start, stop0 + j):
+            the multi-query flash kernel when eligible (ONE int8 cache
+            sweep for all s queries), the XLA dequant path otherwise
+            (wide prefill chunks, mesh serving).  Shared by the
+            global-index chunked path and the per-row-cursor verify —
+            the two differ only in the stop vector."""
+            from mlcomp_tpu.ops.pallas.decode_attention import (
+                CHUNK_MAX_SQ,
+                decode_attention_chunk,
+            )
+            from mlcomp_tpu.ops.quant import pallas_mesh
+
+            if s <= CHUNK_MAX_SQ and pallas_mesh() is None:
+                qp = (
+                    jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+                    if dhp != dh else q
+                )
+                out = decode_attention_chunk(
+                    qp, ckq.value, cks.value, cvq.value, cvs.value,
+                    kv_start=row_start, kv_stop0=stop0,
+                    scale=1.0 / (dh**0.5),
+                )
+                return out[..., :dh]
+            k_scale = cks.value.transpose(0, 1, 3, 2)   # (B, Hkv, L, 1)
+            v_scale = cvs.value.transpose(0, 1, 3, 2)
+            k_all = (
+                ckq.value.astype(jnp.float32) * k_scale
+            ).astype(k.dtype).transpose(0, 2, 1, 3)[..., :dh]
+            v_all = (
+                cvq.value.astype(jnp.float32) * v_scale
+            ).astype(v.dtype).transpose(0, 2, 1, 3)[..., :dh]
+            slots = jnp.arange(l_buf, dtype=jnp.int32)
+            stops = stop0[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+            mask = slots[None, None, None, :] < stops[:, None, :, None]
+            mask = mask & (
+                slots[None, :] >= row_start[:, None]
+            )[:, None, None, :]
+            return dot_product_attention(q, k_all, v_all, mask=mask)
+
         if cache_cursor is not None:
             # per-row cursors (engine contract, see _decode_attention):
-            # scatter each row's K/V at its own slot, window per row
-            if s != 1:
-                raise ValueError(
-                    "cache_cursor is a single-token-step contract (s=1); "
-                    f"got s={s}"
-                )
+            # scatter each row's K/V at its own slot(s), window per row.
+            # s > 1 (round 5) is the engine's speculative verify — the
+            # multi-query kernel takes per-row stop0 directly.
             cur = cache_cursor.astype(jnp.int32)
             rows = jnp.arange(b)
-            ckq.value = ckq.value.at[rows, :, cur].set(kq[:, 0])
-            cvq.value = cvq.value.at[rows, :, cur].set(vq[:, 0])
-            hit = (
-                jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, l_buf), 3)
-                == cur[:, None, None, None]
-            )
             sdt = cks.value.dtype
-            cks.value = jnp.where(
-                hit, ks_.reshape(b, hkv, 1, 1).astype(sdt), cks.value
-            )
-            cvs.value = jnp.where(
-                hit, vs_.reshape(b, hkv, 1, 1).astype(sdt), cvs.value
-            )
+            if s == 1:
+                ckq.value = ckq.value.at[rows, :, cur].set(kq[:, 0])
+                cvq.value = cvq.value.at[rows, :, cur].set(vq[:, 0])
+                hit = (
+                    jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, l_buf), 3)
+                    == cur[:, None, None, None]
+                )
+                cks.value = jnp.where(
+                    hit, ks_.reshape(b, hkv, 1, 1).astype(sdt), cks.value
+                )
+                cvs.value = jnp.where(
+                    hit, vs_.reshape(b, hkv, 1, 1).astype(sdt), cvs.value
+                )
+            else:
+                offs = cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+                ckq.value = ckq.value.at[rows[:, None], :, offs].set(kq)
+                cvq.value = cvq.value.at[rows[:, None], :, offs].set(vq)
+                cks.value = cks.value.at[rows[:, None], :, 0, offs].set(
+                    ks_.astype(sdt)
+                )
+                cvs.value = cvs.value.at[rows[:, None], :, 0, offs].set(
+                    vs_.astype(sdt)
+                )
             if kv_mask is not None:
                 row_start = jnp.argmax(
                     kv_mask.astype(jnp.int32), axis=1
                 ).astype(jnp.int32)
             else:
                 row_start = jnp.zeros((b,), jnp.int32)
-            return flash(row_start, cur + 1)
+            if s == 1:
+                return flash(row_start, cur + 1)
+            return chunk_attend(row_start, cur + 1)
         if s == 1:
             # single-token step (the serving hot path).  Two trace-time
             # knobs below exist because single-session A/Bs through the
@@ -473,47 +534,10 @@ class SelfAttention(nn.Module):
             return dot_product_attention(q, k, v, causal=True, kv_start=start)
 
         def chunked():
-            from mlcomp_tpu.ops.pallas.decode_attention import (
-                CHUNK_MAX_SQ,
-                decode_attention_chunk,
-            )
-            from mlcomp_tpu.ops.quant import pallas_mesh
-
-            # small chunks (the speculative verify shape) take the
-            # multi-query flash kernel: ONE sweep of the int8 cache for
-            # all s queries, dequant in VMEM — the XLA path below
-            # materializes a bf16 copy of the WHOLE buffer per forward
-            # (priced in the speculative bench: it ate the kv8 win).
-            # Wide prefill chunks keep the XLA path (the kernel's
-            # sublane packing is sized for verify widths), as does
-            # mesh serving (no sharded chunk wrapper yet).
-            if s <= CHUNK_MAX_SQ and pallas_mesh() is None:
-                qp = (
-                    jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
-                    if dhp != dh else q
-                )
-                out = decode_attention_chunk(
-                    qp, ckq.value, cks.value, cvq.value, cvs.value,
-                    kv_start=start,
-                    kv_stop0=jnp.broadcast_to(i + 1, (b,)),
-                    scale=1.0 / (dh**0.5),
-                )
-                return out[..., :dh]
-            k_scale = cks.value.transpose(0, 1, 3, 2)       # (B, Hkv, L, 1)
-            v_scale = cvs.value.transpose(0, 1, 3, 2)
-            k_all = (
-                ckq.value.astype(jnp.float32) * k_scale
-            ).astype(k.dtype).transpose(0, 2, 1, 3)[..., :dh]
-            v_all = (
-                cvq.value.astype(jnp.float32) * v_scale
-            ).astype(v.dtype).transpose(0, 2, 1, 3)[..., :dh]
-            slots = jnp.arange(l_buf, dtype=jnp.int32)
-            q_slots = i + jnp.arange(s, dtype=jnp.int32)
-            mask = (slots[None, :] <= q_slots[:, None])[None, None]
-            valid = (slots[None, :] >= start[:, None])[:, None, None, :]
-            return dot_product_attention(
-                q, k_all, v_all, mask=mask & valid
-            )
+            # the per-query stop is the same for every row here (global
+            # cache_index); chunk_attend routes the multi-query kernel
+            # vs XLA dequant
+            return chunk_attend(start, jnp.broadcast_to(i + 1, (b,)))
 
         return jax.lax.cond(i == 0, fresh_prefill, chunked)
 

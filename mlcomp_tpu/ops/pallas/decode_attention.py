@@ -333,10 +333,15 @@ def _kernel_chunk(
         ) * scale                                   # (Hkv, Sp, BLK)
         s = s * ks_ref[0].astype(jnp.float32)
         cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        # per-sublane-row causal stop: row r is query r // rep (pad
-        # rows beyond s_q*rep just mask everything; their output is
-        # sliced away)
-        qrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // rep
+        # per-sublane-row causal stop: row r is query r // rep.  Pad
+        # rows beyond s_q*rep CLAMP to the last query's window — they
+        # compute (zero-vector queries) and their output is sliced
+        # away by the caller; the clamp keeps their window inside the
+        # live range so nothing depends on pad-row masking
+        qrow = jnp.minimum(
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // rep,
+            s_q - 1,
+        )
         s = jnp.where((cols >= lo) & (cols < stop0 + qrow), s, NEG_INF)
 
         m_prev = m_ref[:, :, :1]

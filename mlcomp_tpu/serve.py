@@ -127,6 +127,7 @@ class GenerationService:
         steps_per_dispatch: int = 4,
         prefill_chunk: int = 256,
         spec_k: int = 8,
+        engine_spec_k: Optional[int] = None,
     ):
         import jax
 
@@ -269,6 +270,25 @@ class GenerationService:
             self.batch_sizes = (1,)
             self._stats["spec_tokens"] = 0
             self._stats["spec_forwards"] = 0
+        if engine_spec_k is not None:
+            # BATCHED speculative decoding (round 5, opt-in): the
+            # continuous engine's dispatch becomes a per-row-cursor
+            # verify — up to K+1 tokens per row per dispatch for ~one
+            # step's cost.  Greedy-only fleet: validate the defaults
+            # here so a misconfigured service fails at construction,
+            # not on every defaults-only request.
+            if batcher != "continuous":
+                raise ValueError(
+                    "engine_spec_k needs the continuous batcher"
+                )
+            if self.defaults["temperature"] != 0.0 or (
+                self.defaults["repetition_penalty"] != 1.0
+            ):
+                raise ValueError(
+                    "engine_spec_k engines are greedy-only: service "
+                    "defaults must keep temperature 0 and "
+                    "repetition_penalty 1"
+                )
         if batcher == "continuous":
             from mlcomp_tpu.engine import DecodeEngine
 
@@ -283,6 +303,7 @@ class GenerationService:
                 steps_per_dispatch=steps_per_dispatch,
                 prefill_chunk=prefill_chunk,
                 mesh=mesh,
+                spec_k=engine_spec_k,
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous

@@ -83,6 +83,42 @@ def test_spec_batcher_service_constraints():
         _service(spec_k=0)
 
 
+def test_engine_spec_service_matches_window_reference():
+    """engine_spec_k on the continuous batcher: batched speculative
+    decoding behind the normal service API, greedy-equal to the window
+    batcher on the same weights."""
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+        "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32",
+    })
+    prompt = jnp.asarray(np.random.RandomState(0).randint(1, 64, (1, 8)))
+    params, mstate = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    variables = {"params": params, **mstate}
+    kw = dict(batch_sizes=(1, 2), prompt_buckets=(8, 16),
+              max_new_buckets=(4, 8))
+    svc = GenerationService(model, variables, batcher="continuous",
+                            engine_spec_k=3, **kw)
+    ref = GenerationService(model, variables, batcher="window", **kw)
+    try:
+        rs = np.random.RandomState(4)
+        for n in (5, 9):
+            p = rs.randint(1, 64, n).tolist()
+            got = svc.generate(p, max_new_tokens=6)
+            want = ref.generate(p, max_new_tokens=6)
+            assert got["ids"] == want["ids"], p
+        with pytest.raises(ValueError, match="greedy-only"):
+            svc.generate([1, 2], max_new_tokens=4, temperature=0.9)
+    finally:
+        svc.close()
+        ref.close()
+    with pytest.raises(ValueError, match="continuous"):
+        GenerationService(model, variables, batcher="window",
+                          engine_spec_k=2, **kw)
+    with pytest.raises(ValueError, match="greedy-only"):
+        GenerationService(model, variables, batcher="continuous",
+                          engine_spec_k=2, temperature=0.7, **kw)
+
+
 def test_spec_batcher_warmup_and_concurrent_requests():
     _, svc = _service(spec_k=2)
     try:
