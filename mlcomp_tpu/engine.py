@@ -175,9 +175,18 @@ class DecodeEngine:
                     "multi-query kernel has no sharded wrapper); drop "
                     "spec_k or the mesh"
                 )
+        # +1 scratch slot: a RETIRED row's frozen cursor still receives
+        # the dispatch's cache write (the device retires rows by
+        # masking emission, not by skipping the forward), and its write
+        # span ends one past the last budgeted slot.  The per-row DUS
+        # writes CLAMP at the buffer edge (scatter used to drop), so
+        # without the scratch slot a dead row would overwrite its own
+        # last real K/V — harmless today (retired rows are never read
+        # before slot reuse) but a corruption trap for any future
+        # reader; spec verify widens the span by K.
         self.l_buf = self.prompt_buckets[-1] + self.max_new_cap + (
-            self.spec_k or 0  # verify may write K slots past the budget
-        )
+            self.spec_k or 0
+        ) + 1
         self.vocab = int(getattr(model, "vocab_size"))
         self._jax, self._jnp = jax, jnp
 
