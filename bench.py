@@ -611,9 +611,14 @@ def bench_engine(scan_variants=None) -> None:
     if os.environ.get("MLCOMP_BENCH_SKIP_ENGINE_SPEC", "") not in (
         "1", "true"
     ):
+        # spec_k=7: the verify's GEMMs run slots*(K+1) rows, and 8x8=64
+        # stays within the int8 kernel's measured fat-block decode
+        # boundary (_GEMV_ROWS — K=8 would put 72 rows onto the
+        # 512x512 prefill blocks, re-paying the per-grid-step overhead
+        # the fat blocks were swept to avoid)
         spec_eng = DecodeEngine(
             model, qvars, slots=8, prompt_buckets=(DEC_PROMPT,),
-            max_new_cap=DEC_NEW, quant_kernel=True, spec_k=8,
+            max_new_cap=DEC_NEW, quant_kernel=True, spec_k=7,
         )
         spec_eng._stop.set()
         spec_eng._queue.put(_POISON)
@@ -637,17 +642,32 @@ def bench_engine(scan_variants=None) -> None:
         emitted1 = spec_eng._stats["emitted_tokens"]
         w_spec = statistics.median(walls_s)
         toks_per_disp = (emitted1 - emitted0) / (WINDOWS * n_disp)
-        est_step = max(w_spec * 1e3 - overhead_ms, 1e-3)
-        line["engine_spec"] = {
-            "spec_k": 8,
+        est_step = w_spec * 1e3 - overhead_ms
+        spec = {
+            "spec_k": spec_eng.spec_k,
             "tokens_per_dispatch": round(toks_per_disp, 2),
             "acceptance_tokens_per_row": round(toks_per_disp / 8, 2),
             "dispatch_wall_ms": round(w_spec * 1e3, 3),
-            "verify_step_ms_est": round(est_step, 3),
-            "tokens_per_sec_marginal_est": round(
-                toks_per_disp / (est_step / 1e3), 1
-            ),
+            "k1_scan_wall_ms": round(w1 * 1e3, 3),
         }
+        if est_step > 0.5:
+            spec["verify_step_ms_est"] = round(est_step, 3)
+            spec["tokens_per_sec_marginal_est"] = round(
+                toks_per_disp / (est_step / 1e3), 1
+            )
+        else:
+            # the verify wall landed at/below the measured per-dispatch
+            # overhead: the step cost is under the tunnel's RTT noise
+            # floor and the subtraction estimate is meaningless — the
+            # defensible statement is the direct wall comparison (the
+            # spec dispatch emits >= as many tokens as a K=1 scan
+            # dispatch for no more wall time)
+            spec["verify_step_ms_est"] = None
+            spec["note"] = (
+                "verify wall within RTT noise of a K=1 scan dispatch; "
+                "step cost below the tunnel measurement floor"
+            )
+        line["engine_spec"] = spec
     print(json.dumps(line))
 
 

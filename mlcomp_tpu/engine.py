@@ -165,6 +165,11 @@ class DecodeEngine:
         # chunked forward (the s>1 cache_cursor contract,
         # models/transformer.py; int8 caches ride the multi-query
         # flash kernel).  Greedy-only: submit rejects sampling knobs.
+        # Tuning note (int8 weights): the verify's GEMMs run
+        # slots*(spec_k+1) rows — keep that <= ops/pallas/quant_matmul
+        # _GEMV_ROWS (64) or the kernels fall off the swept fat-block
+        # decode layout onto prefill blocks (measured ~2x per-call at
+        # these shapes); e.g. 8 slots pair with spec_k <= 7.
         self.spec_k = None if spec_k is None else int(spec_k)
         if self.spec_k is not None:
             if self.spec_k < 1:
