@@ -418,7 +418,53 @@ def bench_decode() -> "dict | None":
     return variants
 
 
-def bench_engine(scan_variants=None) -> None:
+def _engine_lm_fixture():
+    """The 1.2B all-int8 serving config shared by the engine and
+    prefix-cache lines (one weight build, one quantize pass)."""
+    import gc
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.ops.quant import quantize_params
+    from mlcomp_tpu.train.state import init_model
+
+    lm_cfg = {
+        "name": "transformer_lm",
+        "vocab_size": LM_VOCAB,
+        "hidden": LM_HIDDEN,
+        "layers": LM_LAYERS,
+        "heads": LM_HEADS,
+        "mlp_dim": 4 * LM_HIDDEN,
+        "dtype": "bfloat16",
+        "decode_fused": True,
+        "kv_quant": True,
+    }
+    model = create_model(lm_cfg)
+    gen = np.random.default_rng(4)
+    prompt128 = jnp.asarray(
+        gen.integers(1, LM_VOCAB, size=(1, 128)), jnp.int32
+    )
+    params, _ = init_model(model, {"x": prompt128}, jax.random.PRNGKey(0))
+    qvars = {"params": quantize_params(params)}
+    del params
+    gc.collect()
+    return model, qvars, gen
+
+
+def _engine_req(ids, n_new):
+    """A queue-shaped request dict for driving engine internals
+    directly (the bench parks the loop thread)."""
+    from concurrent.futures import Future
+
+    return {
+        "ids": ids,
+        "n_new": n_new, "future": Future(), "temperature": 0.0,
+        "top_k": LM_VOCAB, "top_p": 1.0, "eos_id": -1,
+        "logprobs": False, "repetition_penalty": 1.0, "stream": None,
+        "t_submit": time.perf_counter(),
+    }
+
+
+def bench_engine(scan_variants=None) -> "dict | None":
     """CONTINUOUS-ENGINE line (r4 verdict missing #1: the serve default
     had zero on-chip evidence — every decode number came from the
     ``generate`` scan).  Measures the engine's REAL path — the K-step
@@ -445,42 +491,16 @@ def bench_engine(scan_variants=None) -> None:
     worst-case inter-token stall chunked admission imposes on active
     rows, before/after."""
     import gc
-    from concurrent.futures import Future
 
     from mlcomp_tpu.engine import DecodeEngine
-    from mlcomp_tpu.models import create_model
-    from mlcomp_tpu.ops.quant import quantize_params
-    from mlcomp_tpu.train.state import init_model
 
-    lm_cfg = {
-        "name": "transformer_lm",
-        "vocab_size": LM_VOCAB,
-        "hidden": LM_HIDDEN,
-        "layers": LM_LAYERS,
-        "heads": LM_HEADS,
-        "mlp_dim": 4 * LM_HIDDEN,
-        "dtype": "bfloat16",
-        "decode_fused": True,
-        "kv_quant": True,
-    }
-    model = create_model(lm_cfg)
-    gen = np.random.default_rng(4)
-    prompt128 = jnp.asarray(
-        gen.integers(1, LM_VOCAB, size=(1, 128)), jnp.int32
-    )
-    params, _ = init_model(model, {"x": prompt128}, jax.random.PRNGKey(0))
-    qvars = {"params": quantize_params(params)}
-    del params
+    model, qvars, gen = _engine_lm_fixture()
     gc.collect()
 
     def make_req(n_new):
-        return {
-            "ids": gen.integers(1, LM_VOCAB, size=DEC_PROMPT).tolist(),
-            "n_new": n_new, "future": Future(), "temperature": 0.0,
-            "top_k": LM_VOCAB, "top_p": 1.0, "eos_id": -1,
-            "logprobs": False, "repetition_penalty": 1.0, "stream": None,
-            "t_submit": time.perf_counter(),
-        }
+        return _engine_req(
+            gen.integers(1, LM_VOCAB, size=DEC_PROMPT).tolist(), n_new
+        )
 
     def barrier(eng):
         """Completion fetch on whichever buffer the last call updated
@@ -669,6 +689,140 @@ def bench_engine(scan_variants=None) -> None:
             )
         line["engine_spec"] = spec
     print(json.dumps(line))
+    # the prefix-cache line reuses the weights AND the K=8 engine's
+    # compiled programs (prefill/insert/dispatch are config-identical)
+    # so the tunnel compile service is paid once across the two lines
+    return {"model": model, "qvars": qvars, "fns": engines[8]._fns}
+
+
+def bench_prefix_cache(ctx=None) -> None:
+    """REPEATED-PREFIX serving line: the host-RAM prefix KV cache
+    (mlcomp_tpu/cache) against cold prefill on the traffic it targets
+    — prompts sharing a long prefix (system prompts, few-shot
+    templates, retry storms).
+
+    Protocol (tunnel-safe, in-process like the engine line): two
+    engines on the same compiled programs — COLD (no cache) and WARM
+    (prefix resident) — each driven through complete request cycles
+    (chunked admission + decode to budget; the final dispatch's packed
+    fetch is the completion barrier), interleaved windows, medians.
+    Traffic: 2048-token prompts, the first 75% shared (>= the 50%
+    overlap bar), a fresh random suffix per request so the warm engine
+    still prefills and re-captures its suffix chunks every cycle.
+    ``value`` is the warm tokens/s per request cycle; ``vs_baseline``
+    is speedup/2.0 against the >=2x acceptance bar — and is FORCED to
+    0.0 when the equality probe fails, so a bit-exactness regression
+    on this config (the real all-int8 one, not the float32 test
+    fixtures) fails the bar in the parsed record instead of hiding in
+    a boolean nobody reads.  ``exact_match_vs_cold`` reports the probe:
+    an identical request served cold vs from the cache must emit the
+    same tokens — the cache changes the bill, not the text.
+    """
+    from mlcomp_tpu.cache import PrefixKVCache
+    from mlcomp_tpu.engine import DecodeEngine, _POISON
+
+    if ctx is None:
+        ctx = {}
+        ctx["model"], ctx["qvars"], _ = _engine_lm_fixture()
+        ctx["fns"] = {}
+    model, qvars = ctx["model"], ctx["qvars"]
+    gen = np.random.default_rng(11)
+    n_new = 32                         # 4 K=8 dispatches per cycle
+    prefix = gen.integers(1, LM_VOCAB, size=3 * DEC_PROMPT // 4).tolist()
+
+    def make_req():
+        suffix = gen.integers(
+            1, LM_VOCAB, size=DEC_PROMPT - len(prefix)
+        ).tolist()
+        return _engine_req(prefix + suffix, n_new)
+
+    # ~8 chunks per bucket (= the engine line's 256 at the default 2048
+    # prompt; scales down with MLCOMP_BENCH_DEC_PROMPT so small smoke
+    # configs still exercise the hit path, which is chunk-granular).
+    # Must DIVIDE the bucket or the engine falls back to one monolithic
+    # chunk and the hit path silently never engages.
+    chunk = max(1, DEC_PROMPT // 8)
+    while DEC_PROMPT % chunk:
+        chunk -= 1
+
+    def make_engine(cache):
+        eng = DecodeEngine(
+            model, qvars, slots=8, prompt_buckets=(DEC_PROMPT,),
+            max_new_cap=DEC_NEW, quant_kernel=True, steps_per_dispatch=8,
+            prefill_chunk=chunk, prefix_cache=cache,
+        )
+        eng._stop.set()
+        eng._queue.put(_POISON)
+        eng._thread.join(timeout=30)
+        eng._fns.update(ctx["fns"])
+        return eng
+
+    def cycle(eng, req):
+        """One full request: admission chunks + dispatches to budget
+        (the row retires exactly at its budget, freeing the slot); the
+        last dispatch's packed fetch is a real completion barrier."""
+        t0 = time.perf_counter()
+        eng._start_admission(req)
+        while eng._adm is not None:
+            eng._run_admission_chunk()
+        for _ in range(n_new // 8):
+            eng._run_dispatch()
+        return time.perf_counter() - t0
+
+    cold = make_engine(None)
+    warm = make_engine(PrefixKVCache(max_bytes=4 << 30))
+    # compile + seed: one cycle each (the warm engine's first cycle is
+    # its own cold miss — it seeds the prefix; a second warms the
+    # hit-path programs: boundary capture + cached prefill-init).
+    # Captures land on a background worker — flush before depending on
+    # them so the timed hits are real hits.
+    cycle(cold, make_req())
+    cycle(warm, make_req())
+    warm.prefix_cache.flush()
+    cycle(warm, make_req())
+    warm.prefix_cache.flush()
+    walls = {"cold": [], "warm": []}
+    for _ in range(WINDOWS):
+        walls["cold"].append(cycle(cold, make_req()))
+        walls["warm"].append(cycle(warm, make_req()))
+    wc = statistics.median(walls["cold"])
+    ww = statistics.median(walls["warm"])
+
+    # equality leg: the SAME prompt served cold vs from the cache
+    probe = make_req()
+    r_cold = _engine_req(list(probe["ids"]), n_new)
+    r_warm = _engine_req(list(probe["ids"]), n_new)
+    cycle(warm, probe)      # capture the full prompt
+    warm.prefix_cache.flush()
+    cycle(cold, r_cold)
+    cycle(warm, r_warm)     # full-prefix hit
+    ids_cold = r_cold["future"].result(timeout=60)["ids"]
+    hit_result = r_warm["future"].result(timeout=60)
+    exact = ids_cold == hit_result["ids"]
+
+    warm.prefix_cache.flush()
+    stats = warm.prefix_cache.stats()
+    print(json.dumps({
+        "metric": "prefix_cache_repeated_prefix_tokens_per_sec",
+        "value": round(n_new / ww, 1),
+        "unit": "tokens/sec per request cycle (prefill + decode)",
+        "cold_tokens_per_sec": round(n_new / wc, 1),
+        "speedup_vs_cold_prefill": round(wc / ww, 3),
+        "prompt": DEC_PROMPT,
+        "prefix_overlap": round(len(prefix) / DEC_PROMPT, 3),
+        "generated": n_new,
+        "cycle_wall_ms": {"cold": round(wc * 1e3, 1),
+                          "warm": round(ww * 1e3, 1)},
+        "cache_hit_tokens_per_request": hit_result.get(
+            "cache_hit_tokens"
+        ),
+        "exact_match_vs_cold": exact,
+        "cache": {k: stats[k] for k in (
+            "hits", "misses", "used_hit_tokens", "inserted_tokens",
+            "evictions", "bytes", "nodes",
+        )},
+        "vs_baseline": round((wc / ww) / 2.0, 4) if exact else 0.0,
+    }))
 
 
 _QUALITY_FIXTURE = None
@@ -1254,8 +1408,11 @@ def main() -> None:
     variants = None
     if on("MLCOMP_BENCH_SKIP_DECODE"):
         variants = bench_decode()
+    ctx = None
     if on("MLCOMP_BENCH_SKIP_ENGINE"):
-        bench_engine(variants)
+        ctx = bench_engine(variants)
+    if on("MLCOMP_BENCH_SKIP_PREFIX"):
+        bench_prefix_cache(ctx)  # reuses the engine line's programs
     if on("MLCOMP_BENCH_SKIP_LONGCTX"):
         bench_longctx()  # last = cheapest to lose to a bench-budget
         # timeout (the earlier lines are already printed)

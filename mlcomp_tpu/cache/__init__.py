@@ -1,0 +1,15 @@
+"""Host-side prefix KV cache for the decode engine.
+
+- ``prefix_index``: radix token-trie with longest-prefix lookup, LRU
+  eviction under a host-byte budget, and ref-count pinning (pure host,
+  no JAX — the cachecheck harness fuzzes it standalone).
+- ``kv_store``: quantization-aware block storage (bf16 and int8/kv8
+  cache layouts) with device->host capture after prefill and
+  host->device insert that respects the engine's per-row
+  cursor/start/kv_mask contract.
+
+See docs/prefix_cache.md for the design and its invariants.
+"""
+
+from mlcomp_tpu.cache.kv_store import KVBlock, PrefixKVCache  # noqa: F401
+from mlcomp_tpu.cache.prefix_index import Lease, PrefixIndex  # noqa: F401

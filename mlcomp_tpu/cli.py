@@ -358,6 +358,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         prefill_chunk=args.prefill_chunk,
         spec_k=args.spec_k,
         engine_spec_k=args.engine_spec_k,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_bytes=args.prefix_cache_bytes,
     )
     if args.warmup:
         n = service.warmup()
@@ -582,13 +584,32 @@ def main(argv=None) -> int:
         "--engine-spec-k", type=int, default=None,
         help="continuous batcher: BATCHED speculative decoding — every"
         " dispatch drafts + verifies K tokens per slot in one"
-        " per-row-cursor forward (greedy-only fleet; single-chip)",
+        " per-row-cursor forward (greedy-only fleet; single-chip)."
+        " Replaces the K-step scan dispatch, so --steps-per-dispatch"
+        " is ignored (the engine warns if you set both); with"
+        " --quantize kernel keep slots*(K+1) <= 64 or the verify falls"
+        " off the fat-block decode GEMV layout",
     )
     sv.add_argument(
-        "--steps-per-dispatch", type=int, default=4,
+        "--steps-per-dispatch", type=int, default=None,
         help="continuous batcher: decode steps per compiled dispatch"
-        " (K) — one host dispatch per K tokens; joins land at dispatch"
-        " boundaries, so K bounds the extra join latency",
+        " (K, default 4) — one host dispatch per K tokens; joins land"
+        " at dispatch boundaries, so K bounds the extra join latency."
+        " Dead under --engine-spec-k (speculation replaces the K-step"
+        " scan)",
+    )
+    sv.add_argument(
+        "--prefix-cache", action="store_true",
+        help="host-RAM prefix KV cache (continuous batcher,"
+        " single-chip): requests sharing a cached prompt prefix fetch"
+        " its K/V rows from host memory and prefill only the uncached"
+        " suffix; responses carry cache_hit_tokens and GET"
+        " /cache/stats reports hit/miss/eviction counters",
+    )
+    sv.add_argument(
+        "--prefix-cache-bytes", type=int, default=1 << 31,
+        help="host-byte budget for --prefix-cache (default 2 GiB);"
+        " LRU-evicts unpinned prefixes beyond it",
     )
     sv.add_argument(
         "--prefill-chunk", type=int, default=256,

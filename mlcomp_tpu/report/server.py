@@ -10,6 +10,9 @@ Endpoints (all JSON unless noted):
 - ``GET /api/tasks/<id>/metrics/<name>``    one metric series [[step, value]]
 - ``GET /api/workers``                      worker heartbeats
 - ``GET /api/models``                       model-storage inventory
+- ``GET /api/serving``                      live serve-daemon stats (proxy
+  of ``MLCOMP_TPU_SERVE_URL``'s /healthz + prefix-cache /cache/stats
+  hit/miss/eviction counters; ``{"configured": false}`` when unset)
 
 Each request opens its own Store handle (sqlite connections are not
 thread-safe across the ThreadingHTTPServer pool; WAL mode makes the
@@ -55,6 +58,7 @@ _ROUTES = [
     (re.compile(r"^/api/reports/(\d+)$"), "report_payload"),
     (re.compile(r"^/api/workers$"), "workers"),
     (re.compile(r"^/api/models$"), "models"),
+    (re.compile(r"^/api/serving$"), "serving"),
 ]
 
 _DASHBOARD = """<!doctype html>
@@ -530,6 +534,43 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _r_workers(self, store: Store):
         return store.workers()
+
+    def _r_serving(self, store: Store):
+        """Live serving-daemon stats on the dashboard: proxies the
+        `mlcomp-tpu serve` daemon named by ``MLCOMP_TPU_SERVE_URL``
+        (e.g. http://127.0.0.1:8900) — /healthz plus, when the daemon
+        runs a prefix cache, its /cache/stats hit/miss/eviction
+        counters.  Unconfigured is not an error: the dashboard just
+        shows serving as absent."""
+        import urllib.error
+        import urllib.request
+
+        base = os.environ.get("MLCOMP_TPU_SERVE_URL", "").rstrip("/")
+        if not base:
+            return {"configured": False}
+        headers = {}
+        token = os.environ.get("MLCOMP_TPU_SERVE_TOKEN", "")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+
+        def fetch(path):
+            req = urllib.request.Request(base + path, headers=headers)
+            with urllib.request.urlopen(req, timeout=2) as r:
+                return json.loads(r.read())
+
+        out: dict = {"configured": True, "url": base}
+        try:
+            out["health"] = fetch("/healthz")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            out["reachable"] = False
+            out["error"] = f"{type(e).__name__}: {e}"
+            return out
+        out["reachable"] = True
+        try:
+            out["prefix_cache"] = fetch("/cache/stats")
+        except (urllib.error.URLError, OSError, ValueError):
+            out["prefix_cache"] = None  # daemon runs without the cache
+        return out
 
     def _r_models(self, store: Store):
         """Read-only walk of the ModelStorage tree (project/dag/task) —

@@ -43,8 +43,12 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
             "logprobs": [...raw-model log-probs per emitted token...]}
         (sampling/eos/logprobs fields optional; logprobs are
         log_softmax of the unfiltered logits — comparable across
-        sampling settings)
+        sampling settings; with ``--prefix-cache`` responses carry
+        ``cache_hit_tokens``, the prompt tokens whose prefill the
+        host-RAM prefix KV cache skipped)
     GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...}
+    GET  /cache/stats -> prefix-cache hit/miss/eviction/byte counters
+        (404 unless the service was built with ``prefix_cache=True``)
 
 ``MLCOMP_TPU_SERVE_TOKEN`` (optional) demands ``Authorization: Bearer``
 on every route, mirroring the report server's auth.
@@ -124,10 +128,12 @@ class GenerationService:
         mesh=None,
         repetition_penalty: float = 1.0,
         batcher: str = "auto",
-        steps_per_dispatch: int = 4,
+        steps_per_dispatch: Optional[int] = None,
         prefill_chunk: int = 256,
         spec_k: int = 8,
         engine_spec_k: Optional[int] = None,
+        prefix_cache: bool = False,
+        prefix_cache_bytes: int = 1 << 31,
     ):
         import jax
 
@@ -289,6 +295,26 @@ class GenerationService:
                     "defaults must keep temperature 0 and "
                     "repetition_penalty 1"
                 )
+        self.prefix_cache = None
+        if prefix_cache:
+            # host-RAM prefix KV cache (mlcomp_tpu/cache): only the
+            # continuous engine owns per-row cache cursors to insert
+            # into, and host row inserts don't compose with a sharded
+            # cache — fail at construction, not per request
+            if batcher != "continuous":
+                raise ValueError(
+                    "prefix_cache needs the continuous batcher"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "the prefix KV cache is single-chip for now; drop "
+                    "prefix_cache or the mesh"
+                )
+            from mlcomp_tpu.cache import PrefixKVCache
+
+            self.prefix_cache = PrefixKVCache(
+                max_bytes=int(prefix_cache_bytes)
+            )
         if batcher == "continuous":
             from mlcomp_tpu.engine import DecodeEngine
 
@@ -304,6 +330,7 @@ class GenerationService:
                 prefill_chunk=prefill_chunk,
                 mesh=mesh,
                 spec_k=engine_spec_k,
+                prefix_cache=self.prefix_cache,
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous
@@ -462,7 +489,10 @@ class GenerationService:
             ]
             for f in futs:
                 f.result(timeout=600)
-            return len(futs)
+            # prefix-cache capture/insert programs (cheap: no model
+            # trace) — without this the first real request pays their
+            # compile on the engine loop thread mid-serving
+            return len(futs) + self.engine.warm_prefix_fns()
         if self.batcher == "speculative":
             import jax.numpy as jnp
 
@@ -528,6 +558,14 @@ class GenerationService:
             out["requests"] = eng["requests"]
             out["engine"] = eng
         return out
+
+    def cache_stats(self) -> Optional[Dict[str, Any]]:
+        """Prefix-cache counters (hits/misses/evictions/bytes), or None
+        when the service runs without a prefix cache — the payload
+        behind GET /cache/stats."""
+        if self.prefix_cache is None:
+            return None
+        return self.prefix_cache.stats()
 
     def close(self) -> None:
         self._stop.set()
@@ -944,10 +982,19 @@ def serve_http(
         def do_GET(self):  # noqa: N802
             if not self._token_ok():
                 return self._json({"error": "invalid or missing token"}, 403)
-            if self.path.split("?", 1)[0] == "/healthz":
+            route = self.path.split("?", 1)[0]
+            if route == "/healthz":
                 return self._json(
                     {"ok": True, "model": model_name, **service.stats()}
                 )
+            if route == "/cache/stats":
+                stats = service.cache_stats()
+                if stats is None:
+                    return self._json(
+                        {"error": "prefix cache disabled "
+                         "(start with --prefix-cache)"}, 404,
+                    )
+                return self._json(stats)
             return self._json({"error": "not found"}, 404)
 
         def _stream(self, fut, toks: "queue.Queue"):

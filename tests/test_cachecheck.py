@@ -1,0 +1,36 @@
+"""Tier-1 wiring of tools/cachecheck.py: a short fault-injection run
+(randomized submit/insert/retire/evict interleavings against the prefix
+index, structural + pinning + byte-budget invariants after every op)
+plus the multi-threaded concurrent-eviction race.  Pure host code — no
+JAX — so the whole file runs in well under a second."""
+
+import pathlib
+import sys
+
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parents[1] / "tools")
+)
+
+import cachecheck  # noqa: E402
+
+
+def test_cachecheck_single_threaded_under_pressure():
+    ops = cachecheck.run(seed=0, iters=800, max_bytes=1 << 11)
+    # the run must actually exercise every operation class
+    assert all(ops[k] > 0 for k in ops), ops
+
+
+def test_cachecheck_model_checked_no_eviction():
+    # generous budget -> nothing evicts -> lookup lengths are checked
+    # against the brute-force longest-common-prefix model
+    cachecheck.run(seed=1, iters=800, max_bytes=1 << 30,
+                   check_model=True)
+
+
+def test_cachecheck_concurrent_eviction_race():
+    cachecheck.run_threaded(seed=2, iters=300, threads=4,
+                            max_bytes=1 << 11)
+
+
+def test_cachecheck_cli_entrypoint():
+    assert cachecheck.main(["--iters", "100"]) == 0

@@ -182,3 +182,58 @@ def test_spec_engine_quant_kernel_matches_generate():
         assert got["ids"] == np.asarray(ref)[0, 16:].tolist()
     finally:
         eng.close()
+
+
+def test_spec_engine_warns_on_dead_steps_per_dispatch():
+    """ADVICE r5: spec_k replaces the K-step scan, so an explicit
+    steps_per_dispatch != 1 is a dead knob — the constructor says so.
+    The default (None) resolves to 1 for spec engines and stays
+    silent."""
+    import warnings
+
+    model, params = _model_and_params()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # default must NOT warn
+        eng = DecodeEngine(model, {"params": params}, slots=2,
+                           prompt_buckets=(16,), max_new_cap=8, spec_k=2)
+    assert eng.steps_per_dispatch == 1
+    eng.close()
+    with pytest.warns(UserWarning, match="ignore steps_per_dispatch"):
+        eng = DecodeEngine(model, {"params": params}, slots=2,
+                           prompt_buckets=(16,), max_new_cap=8,
+                           spec_k=2, steps_per_dispatch=4)
+    eng.close()
+
+
+def test_spec_engine_warns_past_gemv_row_budget():
+    """r5 verdict weak #3: slots*(spec_k+1) > _GEMV_ROWS drops the int8
+    verify onto prefill blocks (~2x per-call) — the constructor warns
+    instead of leaving the cliff in a comment.  Within budget (8*8=64)
+    stays silent."""
+    import warnings
+
+    from mlcomp_tpu.ops.quant import quantize_params
+
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 128, "hidden": 256,
+        "layers": 1, "heads": 2, "mlp_dim": 512, "dtype": "float32",
+        "kv_quant": True,
+    })
+    prompt = jnp.asarray(np.random.RandomState(7).randint(1, 128, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    q = {"params": quantize_params(params, min_size=1024)}
+    with pytest.warns(UserWarning, match="fat-block"):
+        eng = DecodeEngine(model, q, slots=8, prompt_buckets=(16,),
+                           max_new_cap=6, quant_kernel=True, spec_k=8)
+    eng.close()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        eng = DecodeEngine(model, q, slots=8, prompt_buckets=(16,),
+                           max_new_cap=6, quant_kernel=True, spec_k=7)
+    eng.close()
+    # no int8 kernel -> no cliff -> no warning however big the product
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        eng = DecodeEngine(model, {"params": params}, slots=8,
+                           prompt_buckets=(16,), max_new_cap=6, spec_k=8)
+    eng.close()

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Fault-injection harness for the prefix index (mlcomp_tpu/cache).
+
+Randomizes the interleavings the serving engine produces — submit
+(lookup + pin), retire (release), insert (with and without the
+offset-dedup path), eviction pressure (budget shrink) — against
+``PrefixIndex`` and asserts, after EVERY operation:
+
+- structural invariants (``check_invariants``: byte accounting vs the
+  stored blocks, edge labels, parent pointers);
+- lookup correctness: the match is a prefix of the query, its segments
+  reconstruct exactly the query's matched tokens, and — while the
+  budget rules out eviction — its length equals the brute-force longest
+  common prefix against every sequence ever inserted;
+- ref-count pinning: data a lease holds stays byte-identical across
+  interleaved inserts/splits/evictions until released, and releasing
+  every lease returns the pinned-node count to zero;
+- byte budget: once nothing is pinned, ``evict_to_budget`` always lands
+  at or under ``max_bytes``.
+
+Blocks are ``KVBlock``s whose single array IS the token ids — the same
+slice bookkeeping the real KV rows ride, made self-checking.  No JAX
+anywhere, so the harness runs in milliseconds; tests/test_cachecheck.py
+wires a short run (plus a multi-threaded one — the concurrent-eviction
+race) into tier-1.
+
+Standalone fuzzing:
+
+    python tools/cachecheck.py --iters 20000 --seed 3 --threads 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mlcomp_tpu.cache.kv_store import KVBlock  # noqa: E402
+from mlcomp_tpu.cache.prefix_index import (  # noqa: E402
+    PrefixIndex,
+    _common_prefix_len as len_common,
+)
+
+
+def _block(ids) -> KVBlock:
+    """A block whose payload is the ids themselves: any slice/split
+    bookkeeping error shows up as a token mismatch at verify time."""
+    arr = np.asarray(list(ids), np.int64)[None, :]
+    return KVBlock({"ids": arr}, {"ids": 1}, len(ids))
+
+
+def _lease_tokens(lease):
+    out = []
+    for block, take in lease.segments:
+        out.extend(block.arrays["ids"][0, :take].tolist())
+    return out
+
+
+def _prompt(rng: random.Random, alphabet: int = 6, max_len: int = 24):
+    """Prompts drawn from a small alphabet so shared prefixes (and
+    therefore edge splits) are common, like real templated traffic."""
+    n = rng.randint(1, max_len)
+    return [rng.randrange(1, alphabet) for _ in range(n)]
+
+
+def run(seed: int = 0, iters: int = 2000, max_bytes: int = 1 << 12,
+        check_model: bool = False, index: PrefixIndex = None) -> dict:
+    """One single-threaded fuzz run; returns op counts.  With
+    ``check_model=True`` pass a budget large enough that nothing evicts
+    — lookup lengths are then checked against a brute-force model."""
+    rng = random.Random(seed)
+    idx = index if index is not None else PrefixIndex(max_bytes)
+    held = []          # (lease, expected_tokens) — simulated in-flight slots
+    inserted = []      # every sequence ever inserted (brute-force model)
+    ops = {"lookup": 0, "insert": 0, "offset_insert": 0, "release": 0,
+           "evict": 0}
+
+    def verify_lease(lease, expected):
+        got = _lease_tokens(lease)
+        assert got == expected, (got, expected)
+
+    for _ in range(iters):
+        op = rng.random()
+        if op < 0.35:  # submit: lookup + pin
+            ops["lookup"] += 1
+            q = _prompt(rng)
+            lease = idx.lookup(q)
+            if lease is not None:
+                assert 0 < lease.tokens <= len(q)
+                expected = q[:lease.tokens]
+                verify_lease(lease, expected)
+                if check_model and inserted:
+                    want = max(
+                        len_common(q, s) for s in inserted
+                    )
+                    assert lease.tokens == want, (q, lease.tokens, want)
+                if rng.random() < 0.7 and len(held) < 8:
+                    held.append((lease, expected))
+                else:
+                    lease.release()
+            elif check_model:
+                assert not inserted or max(
+                    len_common(q, s) for s in inserted
+                ) == 0
+        elif op < 0.6:  # insert a full prompt
+            ops["insert"] += 1
+            ids = _prompt(rng)
+            idx.insert(ids, _block(ids))
+            inserted.append(list(ids))
+        elif op < 0.75:  # offset insert: the engine's dedup capture path
+            ops["offset_insert"] += 1
+            base = _prompt(rng) if not inserted else list(
+                rng.choice(inserted)
+            )
+            ids = base + _prompt(rng, max_len=6)
+            lease = idx.lookup(ids)
+            off = 0 if lease is None else lease.tokens
+            if lease is not None:
+                lease.release()
+            idx.insert(ids, _block(ids[off:]), offset=off)
+            inserted.append(list(ids))
+        elif op < 0.9 and held:  # retire: release a pinned lease
+            ops["release"] += 1
+            lease, expected = held.pop(rng.randrange(len(held)))
+            # pinned data must have survived every interleaved
+            # insert/split/eviction since the lookup
+            verify_lease(lease, expected)
+            lease.release()
+        else:  # eviction pressure
+            ops["evict"] += 1
+            idx.evict_to_budget()
+        idx.check_invariants()
+
+    for lease, expected in held:
+        verify_lease(lease, expected)
+        lease.release()
+    idx.check_invariants()
+    if index is None:
+        # global end-state checks only when this run OWNS the index
+        # (under run_threaded, peers may still hold pins)
+        stats = idx.stats()
+        assert stats["pinned_nodes"] == 0, stats
+        idx.evict_to_budget()
+        assert idx.stats()["bytes"] <= max(idx.max_bytes, 0), idx.stats()
+    return ops
+
+
+def run_threaded(seed: int = 0, iters: int = 500, threads: int = 4,
+                 max_bytes: int = 1 << 11) -> None:
+    """The concurrent-eviction race: ``threads`` workers interleave
+    submit/insert/retire/evict on ONE index under a tiny budget.
+    Model checks are off (another thread's evictions are legal), but
+    every structural/pinning/budget invariant must hold throughout."""
+    idx = PrefixIndex(max_bytes)
+    errs = []
+
+    def worker(wseed):
+        try:
+            run(seed=wseed, iters=iters, max_bytes=max_bytes, index=idx)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [
+        threading.Thread(target=worker, args=(seed * 1000 + i,))
+        for i in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+    idx.check_invariants()
+    assert idx.stats()["pinned_nodes"] == 0
+    idx.evict_to_budget()
+    assert idx.stats()["bytes"] <= max_bytes
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--iters", type=int, default=5000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--threads", type=int, default=0,
+                   help="0 = single-threaded with brute-force model "
+                   "checks; N>0 = N racing workers, tiny budget")
+    p.add_argument("--max-bytes", type=int, default=1 << 12)
+    args = p.parse_args(argv)
+    if args.threads:
+        run_threaded(seed=args.seed, iters=args.iters,
+                     threads=args.threads, max_bytes=args.max_bytes)
+        print(f"threaded ok: {args.threads} workers x {args.iters} ops")
+    else:
+        ops = run(seed=args.seed, iters=args.iters,
+                  max_bytes=args.max_bytes)
+        print(f"ok: {ops}")
+        ops = run(seed=args.seed + 1, iters=args.iters,
+                  max_bytes=1 << 30, check_model=True)
+        print(f"model-checked ok: {ops}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
