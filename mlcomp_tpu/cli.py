@@ -356,6 +356,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         batcher=args.batcher,
         steps_per_dispatch=args.steps_per_dispatch,
         prefill_chunk=args.prefill_chunk,
+        engine_pipeline_depth=args.engine_pipeline_depth,
         spec_k=args.spec_k,
         engine_spec_k=args.engine_spec_k,
         prefix_cache=args.prefix_cache,
@@ -597,6 +598,19 @@ def main(argv=None) -> int:
         " at dispatch boundaries, so K bounds the extra join latency."
         " Dead under --engine-spec-k (speculation replaces the K-step"
         " scan)",
+    )
+    sv.add_argument(
+        "--engine-pipeline-depth", type=int, default=None,
+        help="continuous batcher: in-flight dispatch pipeline depth D"
+        " (default 2) — dispatch N+1 is issued with the donated decode"
+        " carry before dispatch N's tokens are read back, so the"
+        " host's per-dispatch overhead hides behind device compute."
+        " 1 = the old synchronous loop (the debug/bisect mode:"
+        " outputs are bit-identical, only slower).  Joins and"
+        " admissions drain the pipeline for their boundary, so the"
+        " one-chunk admission stall bound holds at any depth."
+        " Single-chip for now: an explicit depth > 1 with --mesh is"
+        " rejected rather than silently degrading",
     )
     sv.add_argument(
         "--prefix-cache", action="store_true",

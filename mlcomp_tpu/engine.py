@@ -42,6 +42,23 @@ recovers the round-4 per-token behavior exactly.  ``bench.py``'s
 engine section measures the per-dispatch overhead and the K
 amortization with the in-process A/B methodology (SURVEY §6).
 
+Async dispatch pipeline (this PR, BENCH_r05's ~98 ms host tunnel per
+dispatch next to ~29 ms of device compute): the drive loop keeps up to
+``pipeline_depth`` dispatches IN FLIGHT — dispatch N+1 is issued with
+the donated decode carry before dispatch N's packed token buffer is
+read back, so the host's dispatch+unpack work for N runs concurrent
+with the device executing N+1 (JAX's async dispatch sequences the
+donated carry chain on the device stream; the host never blocks to
+issue).  Depth 1 is exactly the old synchronous loop (the debug/bisect
+mode).  Pipeline-boundary events — a JOIN (queued request with a free
+slot) or an in-flight admission — drain the pipeline first, so
+admission decisions and the insert program always see a fresh host
+slot view and a fully-resolved carry: the one-chunk admission stall
+bound and exact FIFO slot order hold at any depth.  FINISH boundaries
+need no drain: the device retires rows itself, so an extra in-flight
+dispatch on a finished row emits nothing — the host just learns of the
+finish one boundary later.
+
 Mesh composition (round 5, r4 verdict missing #2): pass ``mesh`` and
 the engine's prefill/insert/decode programs run as SPMD programs over
 it — weights arrive sharded (Megatron tp layout from the service
@@ -62,8 +79,9 @@ import queue
 import threading
 import time
 import warnings
+from collections import deque
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -84,6 +102,7 @@ def _fail_future(fut: Future, err: Exception) -> None:
 class _Slot:
     __slots__ = (
         "req", "cursor", "position", "start", "remaining", "emitted",
+        "t_first",
     )
 
     def __init__(self, req, cursor, position, start, remaining):
@@ -93,6 +112,7 @@ class _Slot:
         self.start = start            # first valid cache slot (pads before)
         self.remaining = remaining    # tokens still allowed
         self.emitted: List[int] = []
+        self.t_first = None           # host time the first token landed
 
 
 class _Admission:
@@ -149,6 +169,7 @@ class DecodeEngine:
         mesh=None,
         spec_k: Optional[int] = None,
         prefix_cache=None,
+        pipeline_depth: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -182,6 +203,28 @@ class DecodeEngine:
         if self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         self.mesh = mesh
+        # in-flight dispatch pipeline depth D: the loop issues dispatch
+        # N+1 with the donated carry BEFORE blocking on dispatch N's
+        # packed outputs, hiding the host's dispatch+unpack cost behind
+        # device compute.  None resolves to 2 (double buffering) —
+        # except under a mesh, where SPMD dispatch is not pipelined yet
+        # and the default falls back to the synchronous loop.  An
+        # EXPLICIT depth > 1 with a mesh is rejected rather than
+        # silently degrading (the satellite contract: knobs the
+        # pipeline can't serve yet fail loudly).
+        if pipeline_depth is None:
+            pipeline_depth = 1 if mesh is not None else 2
+        self.pipeline_depth = int(pipeline_depth)
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}"
+            )
+        if self.pipeline_depth > 1 and mesh is not None:
+            raise ValueError(
+                "the dispatch pipeline is single-chip for now (SPMD "
+                "dispatch under a mesh is not pipelined); drop "
+                "pipeline_depth (or pass 1) or the mesh"
+            )
         # speculative dispatch (round 5, opt-in): each dispatch samples
         # tok0 per row, drafts spec_k continuations by DEVICE-side
         # n-gram prompt-lookup over a device-carried ids buffer (tok0
@@ -332,6 +375,24 @@ class DecodeEngine:
             "requests": 0, "steps": 0, "prefills": 0, "dispatches": 0,
             "prefill_chunks": 0, "emitted_tokens": 0,
         }
+        # issued-but-unprocessed dispatches, oldest first: (packed
+        # device buffer, host issue time).  Owned by the loop thread;
+        # close()'s normal path touches it only after the join.
+        self._inflight: Deque[Tuple[Any, float]] = deque()
+        # overlap accounting: hidden_ms is host work done between a
+        # dispatch's issue and the host blocking on its outputs (the
+        # time the pipeline hid behind device compute), wait_ms the
+        # blocked remainder; inflight_sum/issued is the mean in-flight
+        # depth at issue (occupancy)
+        self._pstats = {
+            "issued": 0, "hidden_ms": 0.0, "wait_ms": 0.0,
+            "inflight_sum": 0, "peak_inflight": 0,
+        }
+        # per-request latency reservoirs (most recent ~2k requests;
+        # warmup submissions excluded): time-to-first-token and the
+        # per-token decode interval behind the stats() percentiles
+        self._lat_ttft: Deque[float] = deque(maxlen=2048)
+        self._lat_tok: Deque[float] = deque(maxlen=2048)
         self.step_count = 0
         self._fns: Dict[Any, Any] = {}
         self._stop = threading.Event()
@@ -410,6 +471,16 @@ class DecodeEngine:
             self._stats["requests"] += 1
         return fut
 
+    @staticmethod
+    def _percentiles(samples) -> Optional[Dict[str, float]]:
+        if not samples:
+            return None
+        p50, p95, p99 = np.percentile(
+            np.asarray(samples, np.float64), [50, 95, 99]
+        )
+        return {"p50": round(float(p50), 3), "p95": round(float(p95), 3),
+                "p99": round(float(p99), 3)}
+
     def stats(self) -> Dict[str, Any]:
         active = sum(1 for s in self._host if s is not None)
         out = {
@@ -419,6 +490,32 @@ class DecodeEngine:
             "slots": self.slots,
             "steps_per_dispatch": self.steps_per_dispatch,
             "prefill_chunk": self.prefill_chunk,
+        }
+        p = dict(self._pstats)  # snapshot: the loop thread mutates it
+        done = self._stats["dispatches"]
+        busy = p["hidden_ms"] + p["wait_ms"]
+        out["pipeline"] = {
+            "depth": self.pipeline_depth,
+            "inflight": len(self._inflight),
+            "peak_inflight": p["peak_inflight"],
+            "issued": p["issued"],
+            # mean in-flight depth right after an issue: 1.0 = fully
+            # synchronous, pipeline_depth = fully overlapped
+            "occupancy": round(p["inflight_sum"] / p["issued"], 3)
+            if p["issued"] else None,
+            # host ms per dispatch the pipeline HID behind device
+            # compute vs the ms it still blocked for outputs
+            "host_hidden_ms_per_dispatch": round(p["hidden_ms"] / done, 3)
+            if done else None,
+            "resolve_wait_ms_per_dispatch": round(p["wait_ms"] / done, 3)
+            if done else None,
+            "overlap_efficiency": round(p["hidden_ms"] / busy, 4)
+            if busy > 0 else None,
+        }
+        out["latency"] = {
+            "samples": len(self._lat_ttft),
+            "ttft_ms": self._percentiles(self._lat_ttft),
+            "per_token_ms": self._percentiles(self._lat_tok),
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
@@ -1026,11 +1123,20 @@ class DecodeEngine:
         if error is not None:
             _fail_future(req["future"], error)
             return
+        now = time.perf_counter()
+        if sl.t_first is not None and not req.get("warmup"):
+            # latency reservoirs behind the stats() percentiles: TTFT
+            # is submit -> first token at the HOST (includes queueing,
+            # admission, and any pipeline lag — what a client sees);
+            # per-token is the mean decode interval after it (needs a
+            # second token to exist)
+            self._lat_ttft.append((sl.t_first - req["t_submit"]) * 1e3)
+            n = len(sl.emitted)
+            if n > 1:
+                self._lat_tok.append((now - sl.t_first) * 1e3 / (n - 1))
         result = {
             "ids": [t for t, _ in sl.emitted],
-            "latency_ms": round(
-                (time.perf_counter() - req["t_submit"]) * 1e3, 2
-            ),
+            "latency_ms": round((now - req["t_submit"]) * 1e3, 2),
             "batched_with": self.slots,
         }
         if self.prefix_cache is not None:
@@ -1041,13 +1147,39 @@ class DecodeEngine:
             result["logprobs"] = [round(lp, 5) for _, lp in sl.emitted]
         req["future"].set_result(result)
 
-    def _run_dispatch(self) -> None:
-        # steady state: one device call (state device-carried + donated)
-        # and one packed fetch — nothing per-slot is uploaded here
+    def _issue_dispatch(self) -> None:
+        """Issue ONE dispatch and return WITHOUT blocking on its
+        outputs: one device call (state device-carried + donated),
+        nothing per-slot uploaded.  The donated carry chains device-
+        side — dispatch N+1's inputs are dispatch N's still-in-flight
+        outputs, which JAX sequences on the device stream — and the
+        packed token buffer joins ``_inflight`` for ``_process_oldest``
+        to resolve a boundary later.  That gap is the overlap: the
+        host's dispatch+unpack work for N runs while the device
+        executes N+1."""
         self._dstate, packed = self._dispatch_fn()(
             self.variables, self._dstate
         )
+        self._inflight.append((packed, time.perf_counter()))
+        p = self._pstats
+        p["issued"] += 1
+        p["inflight_sum"] += len(self._inflight)
+        if len(self._inflight) > p["peak_inflight"]:
+            p["peak_inflight"] = len(self._inflight)
+
+    def _process_oldest(self) -> None:
+        """Block on the OLDEST in-flight dispatch's packed outputs and
+        run the host half: stream/bookkeep its tokens, retire finished
+        rows.  FIFO processing keeps step numbering, stream order, and
+        slot retirement identical to the synchronous loop at any
+        pipeline depth."""
+        packed, t_issue = self._inflight.popleft()
+        t_block = time.perf_counter()
         arr = np.asarray(packed)     # (3, K, slots) f32, one transfer
+        t_done = time.perf_counter()
+        p = self._pstats
+        p["hidden_ms"] += (t_block - t_issue) * 1e3
+        p["wait_ms"] += (t_done - t_block) * 1e3
         toks = arr[0].astype(np.int32)
         lps = arr[1]
         valid = arr[2] > 0.5
@@ -1063,6 +1195,8 @@ class DecodeEngine:
                 if sl is None or not valid[kk, i]:
                     continue
                 tok, lp = int(toks[kk, i]), float(lps[kk, i])
+                if sl.t_first is None:
+                    sl.t_first = t_done
                 sl.emitted.append((tok, lp))
                 if sl.req["stream"] is not None:
                     sl.req["stream"].put({
@@ -1075,6 +1209,14 @@ class DecodeEngine:
                 if sl.remaining <= 0 or tok == sl.req["eos_id"]:
                     self._finish(i)
 
+    def _run_dispatch(self) -> None:
+        # the synchronous compose (= pipeline depth 1): issue, then
+        # resolve everything in flight.  Kept as the one-call entry
+        # point for the bench/tools that drive the engine by hand.
+        self._issue_dispatch()
+        while self._inflight:
+            self._process_oldest()
+
     def _loop(self) -> None:
         try:
             self._loop_body()
@@ -1086,6 +1228,10 @@ class DecodeEngine:
             # Idempotent vs close()'s own drain (_finish clears the
             # slot, _fail_future tolerates the loser of the race).
             err = self._broken or RuntimeError("decode engine closed")
+            # unread in-flight outputs are dropped, not resolved: their
+            # rows' futures fail below, and blocking here on a possibly
+            # wedged device would stall close()'s join
+            self._inflight.clear()
             for i in range(self.slots):
                 self._finish(i, error=err)
             self._fail_admission(err)
@@ -1111,9 +1257,16 @@ class DecodeEngine:
             try:
                 # one admission in flight at a time, one CHUNK of it per
                 # boundary: the joiner's prefill interleaves with decode
-                # dispatches instead of stalling them for a whole bucket
+                # dispatches instead of stalling them for a whole bucket.
+                # Invariant: _inflight is EMPTY whenever _adm is set —
+                # the join drain below empties it before an admission
+                # starts, and admission iterations run synchronous
+                # (keep=0), so chunks and the insert always compose
+                # onto a fully-resolved carry.
                 if self._adm is None and None in self._host:
-                    idle = all(s is None for s in self._host)
+                    idle = not self._inflight and all(
+                        s is None for s in self._host
+                    )
                     try:
                         req = self._queue.get(timeout=0.2 if idle else 0)
                     except queue.Empty:
@@ -1121,6 +1274,18 @@ class DecodeEngine:
                     if req is _POISON:
                         continue
                     if req is not None:
+                        # JOIN boundary drain: resolve every pending
+                        # dispatch AFTER the dequeue (a pre-get
+                        # emptiness check would race submit()) so the
+                        # admission sees the host's fresh slot view and
+                        # a resolved carry — exact FIFO slot order and
+                        # the one-chunk stall bound hold at any depth.
+                        # FINISH boundaries need no drain: the device
+                        # retires rows itself, so an in-flight dispatch
+                        # on a finished row emits nothing — the host
+                        # just learns one boundary later.
+                        while self._inflight:
+                            self._process_oldest()
                         try:
                             self._start_admission(req)
                         except Exception as e:
@@ -1132,10 +1297,26 @@ class DecodeEngine:
                         self._run_admission_chunk()
                     except Exception as e:
                         self._fail_admission(e)
+                issued = False
                 if any(s is not None for s in self._host):
-                    self._run_dispatch()
+                    self._issue_dispatch()
+                    issued = True
+                # steady state keeps pipeline_depth dispatches in
+                # flight (resolve down to depth-1 after each issue);
+                # admission boundaries run synchronous, and with
+                # nothing newly issued whatever remains resolves now —
+                # the pipeline never idles on unread outputs
+                keep = self.pipeline_depth - 1 if (
+                    issued and self._adm is None
+                ) else 0
+                while len(self._inflight) > keep:
+                    self._process_oldest()
             except Exception as e:  # engine-level failure: fail active rows
                 self._broken = e
+                # drop unread in-flight outputs NOW: the broken branch
+                # never processes them, and until close() they'd pin
+                # device buffers and show phantom in-flight depth
+                self._inflight.clear()
                 for i in range(self.slots):
                     self._finish(i, error=e)
                 self._fail_admission(e)

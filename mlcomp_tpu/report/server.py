@@ -566,6 +566,16 @@ class _Handler(BaseHTTPRequestHandler):
             out["error"] = f"{type(e).__name__}: {e}"
             return out
         out["reachable"] = True
+        # serving-latency + dispatch-pipeline counters for the
+        # dashboard, lifted out of the health payload: p50/p95/p99
+        # TTFT / per-token percentiles and the engine's pipeline
+        # overlap metrics (in-flight depth, host-hidden ms per
+        # dispatch, occupancy).  Absent (None) for window/speculative
+        # daemons — the dashboard shows them only when present.
+        health = out["health"]
+        eng = health.get("engine") or {}
+        out["latency"] = health.get("latency") or eng.get("latency")
+        out["pipeline"] = eng.get("pipeline")
         try:
             out["prefix_cache"] = fetch("/cache/stats")
         except (urllib.error.URLError, OSError, ValueError):

@@ -46,7 +46,9 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
         sampling settings; with ``--prefix-cache`` responses carry
         ``cache_hit_tokens``, the prompt tokens whose prefill the
         host-RAM prefix KV cache skipped)
-    GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...}
+    GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...,
+                        "latency": {p50/p95/p99 ttft + per-token ms},
+                        "engine": {..., "pipeline": overlap metrics}}
     GET  /cache/stats -> prefix-cache hit/miss/eviction/byte counters
         (404 unless the service was built with ``prefix_cache=True``)
 
@@ -134,6 +136,7 @@ class GenerationService:
         engine_spec_k: Optional[int] = None,
         prefix_cache: bool = False,
         prefix_cache_bytes: int = 1 << 31,
+        engine_pipeline_depth: Optional[int] = None,
     ):
         import jax
 
@@ -295,6 +298,15 @@ class GenerationService:
                     "defaults must keep temperature 0 and "
                     "repetition_penalty 1"
                 )
+        if engine_pipeline_depth is not None and (
+            int(engine_pipeline_depth) > 1 and batcher != "continuous"
+        ):
+            # only the continuous engine has a dispatch loop to
+            # pipeline; fail at construction rather than silently
+            # running the other batcher unpipelined
+            raise ValueError(
+                "engine_pipeline_depth > 1 needs the continuous batcher"
+            )
         self.prefix_cache = None
         if prefix_cache:
             # host-RAM prefix KV cache (mlcomp_tpu/cache): only the
@@ -331,6 +343,7 @@ class GenerationService:
                 mesh=mesh,
                 spec_k=engine_spec_k,
                 prefix_cache=self.prefix_cache,
+                pipeline_depth=engine_pipeline_depth,
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous
@@ -556,6 +569,11 @@ class GenerationService:
             eng = self.engine.stats()
             out["queue_depth"] = eng.pop("queue_depth")
             out["requests"] = eng["requests"]
+            # request-latency percentiles (p50/p95/p99 TTFT and
+            # per-token) ride at the TOP level too: the /healthz
+            # payload and the report server's /api/serving proxy read
+            # them without digging through the engine section
+            out["latency"] = eng.get("latency")
             out["engine"] = eng
         return out
 

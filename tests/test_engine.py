@@ -63,11 +63,13 @@ def test_engine_mid_decode_join_and_no_starvation():
     request finishes before a long one that started earlier (impossible
     under the window batcher, whose batches run to completion).
     K=1 keeps the round-4 per-token join bound; the K>1 bound has its
-    own test below."""
+    own test below.  pipeline_depth=1 pins the SYNCHRONOUS loop whose
+    tight bound this asserts; the depth-2 bound (one extra in-flight
+    dispatch) lives in test_engine_pipeline.py."""
     model, params = _model_and_params()
     eng = DecodeEngine(model, {"params": params}, slots=2,
                        prompt_buckets=(16,), max_new_cap=16,
-                       steps_per_dispatch=1)
+                       steps_per_dispatch=1, pipeline_depth=1)
     try:
         qa: "queue.Queue" = queue.Queue()
         fa = eng.submit([3, 14, 15, 9, 2], 12, stream=qa)
@@ -297,12 +299,14 @@ def test_engine_k_step_dispatch_matches_and_bounds_join():
     bare generate (the inner lax.scan replicates the per-token math),
     eos still stops a row mid-dispatch, and a mid-decode join lands
     within ~2K steps of submission (one in-flight dispatch + admission
-    + its own first dispatch)."""
+    + its own first dispatch).  pipeline_depth=1: the ~2K bound is the
+    synchronous loop's; pipelined joins add K per extra in-flight
+    dispatch (test_engine_pipeline.py)."""
     K = 4
     model, params = _model_and_params()
     eng = DecodeEngine(model, {"params": params}, slots=2,
                        prompt_buckets=(16,), max_new_cap=16,
-                       steps_per_dispatch=K)
+                       steps_per_dispatch=K, pipeline_depth=1)
     try:
         ids = [3, 14, 15, 9, 2]
         got = eng.submit(ids, 11).result(timeout=300)  # not a K multiple

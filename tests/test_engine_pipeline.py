@@ -1,0 +1,278 @@
+"""Async double-buffered dispatch pipeline (engine pipeline_depth):
+depth-2 output equality with the synchronous loop across cache
+layouts, mixed knobs, mid-stream admission and EOS mid-dispatch;
+close/submit races with a dispatch in flight; knob rejection; and the
+overlap/latency metrics in stats()."""
+
+import queue
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlcomp_tpu.engine import DecodeEngine, _fail_future
+from mlcomp_tpu.models import create_model
+from mlcomp_tpu.models.generation import generate
+from mlcomp_tpu.serve import GenerationService
+from mlcomp_tpu.train.state import init_model
+
+
+def _model_and_params(kv_quant=False, seed=0):
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 64, "hidden": 64,
+        "layers": 2, "heads": 2, "mlp_dim": 128, "dtype": "float32",
+        "kv_quant": kv_quant,
+    })
+    prompt = jnp.asarray(np.random.RandomState(seed).randint(1, 64, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(seed))
+    return model, params
+
+
+def _reference(model, params, ids, n_new, bucket=16, **kw):
+    prompt = np.full((1, bucket), 0, np.int32)
+    mask = np.zeros((1, bucket), bool)
+    prompt[0, bucket - len(ids):] = ids
+    mask[0, bucket - len(ids):] = True
+    out = generate(
+        model, {"params": params}, jnp.asarray(prompt), n_new,
+        prompt_mask=jnp.asarray(mask), **kw,
+    )
+    return np.asarray(out)[0, bucket:].tolist()
+
+
+def _mixed_workload(model, params, depth, kv_quant):
+    """Drive one engine at the given depth through the satellite's
+    workload: mixed knobs (greedy + logprobs, repetition penalty, an
+    EOS that lands mid-dispatch), mixed lengths across two prompt
+    buckets, and a mid-stream admission (C submitted while A streams,
+    joining only when a slot frees).  Returns the comparable outputs
+    (ids + logprobs; latencies excluded — the pipeline moves time)."""
+    rs = np.random.RandomState(11)
+    ids_a = rs.randint(1, 64, 5).tolist()
+    ids_b = rs.randint(1, 64, 20).tolist()     # lands in the 32 bucket
+    ids_c = rs.randint(1, 64, 3).tolist()
+    # EOS mid-dispatch: C stops at its first greedy token, i.e. inside
+    # step 1 of a K=2 dispatch (deterministic: greedy reference)
+    eos_c = _reference(model, params, ids_c, 1, bucket=16)[0]
+    eng = DecodeEngine(model, {"params": params}, slots=2,
+                       prompt_buckets=(16, 32), max_new_cap=12,
+                       steps_per_dispatch=2, pipeline_depth=depth)
+    try:
+        qa: "queue.Queue" = queue.Queue()
+        fa = eng.submit(ids_a, 9, logprobs=True, stream=qa)
+        qa.get(timeout=300)                    # A is decoding
+        fb = eng.submit(ids_b, 7, repetition_penalty=1.5)
+        fc = eng.submit(ids_c, 6, eos_id=eos_c)  # queues: slots full
+        ra = fa.result(timeout=300)
+        rb = fb.result(timeout=300)
+        rc = fc.result(timeout=300)
+        st = eng.stats()
+        assert st["pipeline"]["depth"] == depth
+        if depth > 1:
+            # the pipeline actually ran overlapped at steady state
+            assert st["pipeline"]["peak_inflight"] >= 2
+    finally:
+        eng.close()
+    return {
+        "a": (ra["ids"], ra["logprobs"]),
+        "b": rb["ids"],
+        "c": rc["ids"],
+        "eos_c": eos_c,
+    }
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_depth2_bit_identical_to_depth1(kv_quant):
+    """The acceptance equality: a depth-2 pipelined engine's outputs
+    (tokens AND logprobs) are bit-identical to depth-1 for a
+    mixed-knob, mixed-length workload on both cache layouts, including
+    a mid-stream admission and an EOS mid-dispatch — the pipeline may
+    reorder host work, never tokens."""
+    model, params = _model_and_params(kv_quant)
+    d1 = _mixed_workload(model, params, 1, kv_quant)
+    d2 = _mixed_workload(model, params, 2, kv_quant)
+    assert d1 == d2
+    # and both match bare generate (not just each other)
+    ids_a = d1["a"][0]
+    rs = np.random.RandomState(11)
+    ref_a = _reference(model, params, rs.randint(1, 64, 5).tolist(), 9)
+    ref_b = _reference(
+        model, params, rs.randint(1, 64, 20).tolist(), 7, bucket=32,
+        temperature=jnp.zeros((1,)),
+        repetition_penalty=jnp.asarray([1.5]),
+    )
+    assert ids_a == ref_a
+    assert d1["b"] == ref_b
+    assert d1["c"] == [d1["eos_c"]]            # EOS stopped it at one
+
+
+def test_pipeline_join_bound_depth2():
+    """A join under depth 2 pays at most the in-flight dispatch, the
+    drain, and its own first dispatch: first token within
+    step_at_submit + 2 + (depth-1) steps at K=1."""
+    model, params = _model_and_params()
+    eng = DecodeEngine(model, {"params": params}, slots=2,
+                       prompt_buckets=(16,), max_new_cap=16,
+                       steps_per_dispatch=1, pipeline_depth=2)
+    try:
+        qa: "queue.Queue" = queue.Queue()
+        eng.submit([3, 14, 15, 9, 2], 16, stream=qa)
+        qa.get(timeout=300)                    # A is decoding
+        step_at_submit = eng.step_count
+        qb: "queue.Queue" = queue.Queue()
+        eng.submit([7, 3, 44], 2, stream=qb)
+        first_b = qb.get(timeout=300)
+        assert first_b["step"] <= step_at_submit + 3, (
+            first_b, step_at_submit
+        )
+    finally:
+        eng.close()
+
+
+def test_close_with_dispatch_in_flight_fails_pending_exactly_once():
+    """The satellite race contract: close() with dispatches in flight
+    resolves EVERY pending future exactly once (result or 'closed'
+    error, never InvalidStateError), leaves nothing unread in the
+    pipeline, and submit-after-close still raises cleanly."""
+    model, params = _model_and_params()
+    eng = DecodeEngine(model, {"params": params}, slots=2,
+                       prompt_buckets=(16,), max_new_cap=16,
+                       steps_per_dispatch=1, pipeline_depth=2)
+    q: "queue.Queue" = queue.Queue()
+    futs = [eng.submit([3, 14, 15, 9, 2], 16, stream=q)]
+    q.get(timeout=300)       # decoding: the pipeline holds a dispatch
+    futs += [eng.submit([1, 2], 16) for _ in range(3)]  # active + queued
+    eng.close()
+    assert not eng._thread.is_alive()
+    assert not eng._inflight  # loop finally dropped the unread outputs
+    for f in futs:
+        assert f.done()
+        try:
+            f.result(timeout=0)
+        except RuntimeError as e:
+            assert "closed" in str(e)
+    # exactly-once: a second failure attempt on an already-resolved
+    # future is a no-op (the _fail_future idempotence contract)
+    _fail_future(futs[0], RuntimeError("other"))
+    if futs[0].exception() is not None:
+        assert "closed" in str(futs[0].exception())
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit([1], 2)
+
+
+def test_pipeline_depth_validation_and_mesh_rejection():
+    """Depth < 1 and explicit depth > 1 under a mesh are rejected at
+    construction (not silently degraded); the DEFAULT under a mesh
+    resolves to the synchronous loop; depth > 1 at the service level
+    needs the continuous batcher."""
+    model, params = _model_and_params()
+    kw = dict(slots=2, prompt_buckets=(16,), max_new_cap=8)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        DecodeEngine(model, {"params": params}, pipeline_depth=0, **kw)
+    with pytest.raises(ValueError, match="single-chip"):
+        DecodeEngine(model, {"params": params}, mesh=object(),
+                     pipeline_depth=2, **kw)
+    eng = DecodeEngine(model, {"params": params}, mesh=object(), **kw)
+    try:
+        assert eng.pipeline_depth == 1  # mesh default: synchronous
+    finally:
+        eng.close()
+    eng = DecodeEngine(model, {"params": params}, **kw)
+    try:
+        assert eng.pipeline_depth == 2  # single-chip default: pipelined
+    finally:
+        eng.close()
+    with pytest.raises(ValueError, match="continuous"):
+        GenerationService(
+            model, {"params": params}, batcher="window", batch_sizes=(1,),
+            prompt_buckets=(16,), max_new_buckets=(8,),
+            engine_pipeline_depth=2,
+        )
+
+
+def test_pipeline_overlap_metrics_and_latency_percentiles():
+    """stats() carries the overlap metrics (in-flight depth, hidden vs
+    wait ms, occupancy) and per-request latency percentiles; the
+    service surfaces both (latency at the top level for /healthz and
+    the /api/serving proxy)."""
+    model, params = _model_and_params()
+    svc = GenerationService(
+        model, {"params": params}, batch_sizes=(1, 2),
+        prompt_buckets=(16,), max_new_buckets=(8,),
+    )
+    try:
+        svc.generate([5, 6, 7], 6)
+        svc.generate([9, 2, 4], 6)
+        st = svc.stats()
+        pl = st["engine"]["pipeline"]
+        assert pl["depth"] == 2
+        assert pl["issued"] >= 2 and pl["peak_inflight"] == 2
+        assert 1.0 <= pl["occupancy"] <= 2.0
+        assert pl["host_hidden_ms_per_dispatch"] >= 0.0
+        assert pl["resolve_wait_ms_per_dispatch"] >= 0.0
+        assert 0.0 <= pl["overlap_efficiency"] <= 1.0
+        lat = st["latency"]
+        assert lat is st["engine"]["latency"]
+        assert lat["samples"] == 2
+        for key in ("ttft_ms", "per_token_ms"):
+            pcts = lat[key]
+            assert pcts["p50"] > 0
+            assert pcts["p50"] <= pcts["p95"] <= pcts["p99"]
+    finally:
+        svc.close()
+
+
+def test_report_server_serving_proxy_lifts_latency_and_pipeline():
+    """/api/serving lifts the daemon's latency percentiles and
+    pipeline overlap metrics to the top level of its payload."""
+    import json
+    import os
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mlcomp_tpu.report.server import _Handler as ReportHandler
+
+    health = {
+        "ok": True,
+        "latency": {"samples": 1,
+                    "ttft_ms": {"p50": 5.0, "p95": 5.0, "p99": 5.0},
+                    "per_token_ms": None},
+        "engine": {"pipeline": {"depth": 2, "overlap_efficiency": 0.7}},
+    }
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                body = json.dumps(health).encode()
+                self.send_response(200)
+            else:
+                body = b'{"error": "disabled"}'
+                self.send_response(404)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    stub = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=stub.serve_forever, daemon=True).start()
+    old = os.environ.get("MLCOMP_TPU_SERVE_URL")
+    os.environ["MLCOMP_TPU_SERVE_URL"] = (
+        f"http://127.0.0.1:{stub.server_address[1]}"
+    )
+    try:
+        out = ReportHandler._r_serving(None, None)
+        assert out["reachable"] is True
+        assert out["latency"]["ttft_ms"]["p50"] == 5.0
+        assert out["pipeline"]["depth"] == 2
+        assert out["prefix_cache"] is None  # daemon runs without one
+    finally:
+        stub.shutdown()
+        stub.server_close()
+        if old is None:
+            os.environ.pop("MLCOMP_TPU_SERVE_URL", None)
+        else:
+            os.environ["MLCOMP_TPU_SERVE_URL"] = old

@@ -111,3 +111,25 @@ for plane in space.planes:
               f"({grand/1e3/K:.3f} ms/step if no overlap)")
         for nm, us in total.most_common(30):
             print(f"  {us/K:8.1f} us/step  x{counts[nm]/K:6.1f}  {nm}")
+
+# Host-side pipeline A/B: the same dispatch driven synchronous
+# (issue + resolve) vs double-buffered (issue N+1 before resolving N).
+# The device per-op durations above are depth-invariant; the wall
+# delta here is purely the host dispatch overhead the in-flight
+# pipeline hides.
+walls = {1: [], 2: []}
+for _ in range(3):
+    t0 = time.perf_counter()
+    eng._run_dispatch()
+    walls[1].append(time.perf_counter() - t0)
+    eng._issue_dispatch()  # prime outside the clock
+    t0 = time.perf_counter()
+    eng._issue_dispatch()
+    eng._process_oldest()
+    walls[2].append(time.perf_counter() - t0)
+    while eng._inflight:
+        eng._process_oldest()
+d1, d2 = (1e3 * min(walls[k]) for k in (1, 2))
+print(f"\npipeline A/B (host wall per dispatch, best of 3): "
+      f"depth1 {d1:.1f} ms, depth2 {d2:.1f} ms, "
+      f"hidden {max(d1 - d2, 0.0):.1f} ms")
