@@ -217,7 +217,11 @@ class PrefixKVCache:
         import threading
 
         from mlcomp_tpu.cache.prefix_index import PrefixIndex
+        from mlcomp_tpu.utils.trace import null_tracer
 
+        # the engine re-points this at its flight recorder so capture
+        # spans land in the same trace (on the worker's own track)
+        self.tracer = null_tracer()
         self.index = PrefixIndex(max_bytes)
         for key in ("used_hits", "used_hit_tokens", "insert_errors",
                     "insert_dropped"):
@@ -304,8 +308,17 @@ class PrefixKVCache:
                 return
             capture_call, cache, ids, start_pad, lo = item
             try:
-                rows = [np.asarray(r) for r in capture_call(cache)]
-                self.insert(ids, rows, start_pad, lo)
+                # device->host fetch + host copies + trie insert, off
+                # the engine loop thread — spanned so a slow capture
+                # shows up on the worker's track, not as engine stall
+                with self.tracer.span(
+                    "prefix_cache.capture", tokens=len(ids),
+                    capture_lo=lo,
+                ) as sp:
+                    rows = [np.asarray(r) for r in capture_call(cache)]
+                    sp["new_tokens"] = self.insert(
+                        ids, rows, start_pad, lo
+                    )
             except Exception as e:  # best-effort: never kill serving
                 with self.index._lock:
                     self.index.counters["insert_errors"] += 1

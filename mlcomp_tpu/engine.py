@@ -75,6 +75,7 @@ No upstream analog: the reference framework has no serving path at all.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -84,6 +85,8 @@ from concurrent.futures import Future
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from mlcomp_tpu.utils.trace import Tracer, null_tracer
 
 _POISON = object()  # close() wakes a blocked queue.get with this
 
@@ -170,6 +173,8 @@ class DecodeEngine:
         spec_k: Optional[int] = None,
         prefix_cache=None,
         pipeline_depth: Optional[int] = None,
+        flight_recorder_events: Optional[int] = 32768,
+        metrics=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -376,9 +381,10 @@ class DecodeEngine:
             "prefill_chunks": 0, "emitted_tokens": 0,
         }
         # issued-but-unprocessed dispatches, oldest first: (packed
-        # device buffer, host issue time).  Owned by the loop thread;
+        # device buffer, host issue time, dispatch seq — the flight
+        # recorder's async-span id).  Owned by the loop thread;
         # close()'s normal path touches it only after the join.
-        self._inflight: Deque[Tuple[Any, float]] = deque()
+        self._inflight: Deque[Tuple[Any, float, int]] = deque()
         # overlap accounting: hidden_ms is host work done between a
         # dispatch's issue and the host blocking on its outputs (the
         # time the pipeline hid behind device compute), wait_ms the
@@ -390,9 +396,46 @@ class DecodeEngine:
         }
         # per-request latency reservoirs (most recent ~2k requests;
         # warmup submissions excluded): time-to-first-token and the
-        # per-token decode interval behind the stats() percentiles
+        # per-token decode interval behind the stats() percentiles.
+        # The deques WINDOW the percentiles; the *_n lifetime counts
+        # keep long runs honest — len(deque) saturates at maxlen and
+        # silently misrepresents how many requests the percentiles
+        # summarize
         self._lat_ttft: Deque[float] = deque(maxlen=2048)
         self._lat_tok: Deque[float] = deque(maxlen=2048)
+        self._lat_ttft_n = 0
+        self._lat_tok_n = 0
+        # flight recorder: an always-on bounded ring of dispatch /
+        # admission / prefix-cache / request-lifecycle events, exported
+        # on demand (serve's GET /trace).  0/None disables (the bench
+        # A/B arm); overhead is a dict append per event — gated <1% of
+        # dispatch wall by bench.py's recorder A/B
+        self.recorder: Tracer = (
+            Tracer(max_events=int(flight_recorder_events))
+            if flight_recorder_events else null_tracer()
+        )
+        self._rid = itertools.count(1)       # request-lifecycle trace ids
+        self._dispatch_seq = itertools.count(1)
+        if prefix_cache is not None:
+            # the capture worker's spans land on its own thread track
+            prefix_cache.tracer = self.recorder
+        # metrics registry (mlcomp_tpu/obs): the caller (the serving
+        # service) passes its scrape registry; standalone engines keep
+        # a private one so instruments never need None-guards
+        from mlcomp_tpu.obs.metrics import DEFAULT_MS_BUCKETS, Registry
+
+        self.metrics = metrics if metrics is not None else Registry()
+        self._hist_ttft = self.metrics.histogram(
+            "mlcomp_engine_ttft_ms",
+            "Submit -> first token at the host, per finished request",
+            buckets=DEFAULT_MS_BUCKETS,
+        )
+        self._hist_tok = self.metrics.histogram(
+            "mlcomp_engine_per_token_ms",
+            "Mean decode interval after the first token, per request",
+            buckets=DEFAULT_MS_BUCKETS,
+        )
+        self.metrics.register_collector(self._collect_metrics)
         self.step_count = 0
         self._fns: Dict[Any, Any] = {}
         self._stop = threading.Event()
@@ -443,6 +486,15 @@ class DecodeEngine:
                 f"decode engine is down: {self._broken!r}"
             ) from self._broken
         fut: Future = Future()
+        # request-lifecycle trace: one async span per request
+        # (queue -> admit -> first_token -> finish), correlated by rid.
+        # Warmup's dummy submissions stay out of the recording like
+        # they stay out of every other request-visible counter.
+        rid = next(self._rid) if _count else 0
+        if rid:
+            self.recorder.async_begin(
+                "request", rid, cat="req", prompt=len(ids), n_new=n_new,
+            )
         self._queue.put({
             "ids": ids, "n_new": n_new, "future": fut,
             "temperature": float(temperature),
@@ -453,6 +505,7 @@ class DecodeEngine:
             "repetition_penalty": float(repetition_penalty),
             "stream": stream,
             "t_submit": time.perf_counter(),
+            "rid": rid,
             # warmup's dummy prompts must not seed (or probe) the prefix
             # cache — they'd pin budget with [1]*bucket junk
             "warmup": not _count,
@@ -513,13 +566,84 @@ class DecodeEngine:
             if busy > 0 else None,
         }
         out["latency"] = {
+            # "samples" is the WINDOW the percentiles summarize (the
+            # deque, capped at its maxlen); "lifetime_samples" is the
+            # true request count — on long runs the former saturates
+            # and only the latter keeps growing
             "samples": len(self._lat_ttft),
+            "lifetime_samples": self._lat_ttft_n,
             "ttft_ms": self._percentiles(self._lat_ttft),
             "per_token_ms": self._percentiles(self._lat_tok),
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
         return out
+
+    def _collect_metrics(self) -> None:
+        """Scrape-time collector: snapshot the engine's monotonic
+        stats into the registry (set_total keeps counters monotonic
+        across scrapes) — the hot path pays nothing for /metrics."""
+        m = self.metrics
+        st = self._stats
+
+        def ctr(name, help, value):
+            m.counter(name, help).set_total(value)
+
+        def gau(name, help, value):
+            m.gauge(name, help).set(value)
+
+        ctr("mlcomp_engine_requests_total",
+            "Real (non-warmup) requests submitted", st["requests"])
+        ctr("mlcomp_engine_dispatches_total",
+            "Decode dispatches resolved", st["dispatches"])
+        ctr("mlcomp_engine_steps_total",
+            "Device decode forwards", st["steps"])
+        ctr("mlcomp_engine_emitted_tokens_total",
+            "Tokens emitted to requests", st["emitted_tokens"])
+        ctr("mlcomp_engine_prefills_total",
+            "Admissions completed (rows inserted)", st["prefills"])
+        ctr("mlcomp_engine_prefill_chunks_total",
+            "Prefill chunks run", st["prefill_chunks"])
+        ctr("mlcomp_engine_latency_samples_total",
+            "Requests behind the TTFT percentiles (lifetime)",
+            self._lat_ttft_n)
+        gau("mlcomp_engine_slots", "Configured decode slots", self.slots)
+        gau("mlcomp_engine_active_slots", "Slots currently decoding",
+            sum(1 for s in self._host if s is not None))
+        gau("mlcomp_engine_queue_depth", "Requests waiting for a slot",
+            self._queue.qsize())
+        p = dict(self._pstats)
+        ctr("mlcomp_engine_pipeline_issued_total",
+            "Dispatches issued into the pipeline", p["issued"])
+        ctr("mlcomp_engine_pipeline_hidden_ms_total",
+            "Host ms hidden behind in-flight device compute",
+            p["hidden_ms"])
+        ctr("mlcomp_engine_pipeline_wait_ms_total",
+            "Host ms blocked on dispatch outputs", p["wait_ms"])
+        gau("mlcomp_engine_pipeline_depth", "Configured pipeline depth",
+            self.pipeline_depth)
+        gau("mlcomp_engine_pipeline_inflight",
+            "Dispatches currently in flight", len(self._inflight))
+        gau("mlcomp_engine_pipeline_peak_inflight",
+            "Peak in-flight dispatch depth", p["peak_inflight"])
+        busy = p["hidden_ms"] + p["wait_ms"]
+        gau("mlcomp_engine_pipeline_overlap_efficiency",
+            "hidden_ms / (hidden_ms + wait_ms) since start",
+            p["hidden_ms"] / busy if busy > 0 else 0.0)
+        ctr("mlcomp_engine_trace_events_dropped_total",
+            "Flight-recorder ring evictions", self.recorder.dropped)
+        if self.prefix_cache is not None:
+            cs = self.prefix_cache.stats()
+            for key in ("lookups", "hits", "misses", "matched_tokens",
+                        "used_hits", "used_hit_tokens", "inserted_tokens",
+                        "evictions", "evicted_tokens", "insert_errors",
+                        "insert_dropped"):
+                ctr(f"mlcomp_prefix_cache_{key}_total",
+                    f"Prefix KV cache {key.replace('_', ' ')}", cs[key])
+            for key in ("bytes", "max_bytes", "nodes", "pinned_nodes",
+                        "capture_queue_depth"):
+                gau(f"mlcomp_prefix_cache_{key}",
+                    f"Prefix KV cache {key.replace('_', ' ')}", cs[key])
 
     def close(self, timeout: Optional[float] = 60.0) -> None:
         """Stop the step thread, then fail everything still in flight.
@@ -567,6 +691,10 @@ class DecodeEngine:
         adm, self._adm = self._adm, None
         if adm.req["stream"] is not None:
             adm.req["stream"].put(None)
+        if adm.req.get("rid"):
+            self.recorder.async_end(
+                "request", adm.req["rid"], cat="req", error=True,
+            )
         _fail_future(adm.req["future"], err)
 
     def _drain_queue(self, err: Exception) -> None:
@@ -579,6 +707,10 @@ class DecodeEngine:
                 continue
             if req["stream"] is not None:
                 req["stream"].put(None)
+            if req.get("rid"):
+                self.recorder.async_end(
+                    "request", req["rid"], cat="req", error=True,
+                )
             _fail_future(req["future"], err)
 
     # ----------------------------------------------------------- programs
@@ -1007,36 +1139,45 @@ class DecodeEngine:
         # than one chunk boundary, but far less than the skipped
         # chunks' total stall.  Overlapping the upload with dispatches
         # (an extra admission state) is the open follow-up.
+        rid = req.get("rid", 0)
+        if rid:
+            self.recorder.async_instant(
+                "admit", rid, cat="req", bucket=s_bucket,
+            )
         hit_tokens = 0
         if self.prefix_cache is not None and not req.get("warmup"):
-            lease = self.prefix_cache.lookup(ids)
-            if lease is not None:
-                try:
-                    adm.skip_capture = lease.tokens >= len(ids)
-                    p = min(lease.tokens, len(ids) - 1)
-                    cached_chunk = (start_pad + p) // c
-                    if cached_chunk > first_chunk:
-                        hit_tokens = cached_chunk * c - start_pad
-                        rows = self.prefix_cache.assemble(
-                            lease, cached_chunk * c, start_pad, hit_tokens
-                        )
-                        adm.cache = self._prefill_init_cached_fn(
-                            cached_chunk * c
-                        )(
-                            jnp.int32(cached_chunk * c),
-                            *[jnp.asarray(r) for r in rows],
-                        )
-                        adm.next_chunk = cached_chunk
-                finally:
-                    lease.release()
+            # one tracing idiom: the lookup (and, on a hit, the host
+            # assembly + upload — the stall active rows actually pay)
+            # is a structured span on the engine track, its outcome in
+            # the span args (hit_tokens=0 is a recorded miss)
+            with self.recorder.span(
+                "prefix_cache.lookup", track="engine.loop",
+                prompt=len(ids), rid=rid,
+            ) as sp:
+                lease = self.prefix_cache.lookup(ids)
+                if lease is not None:
+                    try:
+                        adm.skip_capture = lease.tokens >= len(ids)
+                        p = min(lease.tokens, len(ids) - 1)
+                        cached_chunk = (start_pad + p) // c
+                        if cached_chunk > first_chunk:
+                            hit_tokens = cached_chunk * c - start_pad
+                            rows = self.prefix_cache.assemble(
+                                lease, cached_chunk * c, start_pad,
+                                hit_tokens,
+                            )
+                            adm.cache = self._prefill_init_cached_fn(
+                                cached_chunk * c
+                            )(
+                                jnp.int32(cached_chunk * c),
+                                *[jnp.asarray(r) for r in rows],
+                            )
+                            adm.next_chunk = cached_chunk
+                    finally:
+                        lease.release()
+                sp["hit_tokens"] = hit_tokens
             if hit_tokens:
                 self.prefix_cache.record_hit(hit_tokens)
-                from mlcomp_tpu.utils.trace import get_tracer
-
-                get_tracer().instant(
-                    "prefix_cache_hit", tokens=hit_tokens,
-                    prompt=len(ids),
-                )
         req["cache_hit_tokens"] = hit_tokens
         if adm.cache is None:
             adm.cache = self._prefill_init_fn()(jnp.int32(first_chunk * c))
@@ -1051,12 +1192,17 @@ class DecodeEngine:
         adm = self._adm
         c, s_bucket = adm.chunk, adm.s_bucket
         lo = adm.next_chunk * c
-        logits, adm.cache = self._prefill_chunk_fn(c)(
-            self.variables, adm.cache,
-            jnp.asarray(adm.row[:, lo:lo + c]),
-            jnp.asarray(adm.positions[:, lo:lo + c]),
-            adm.kv_mask,
-        )
+        with self.recorder.span(
+            "prefill_chunk", track="engine.loop",
+            chunk=adm.next_chunk, of=adm.n_chunks,
+            rid=adm.req.get("rid", 0),
+        ):
+            logits, adm.cache = self._prefill_chunk_fn(c)(
+                self.variables, adm.cache,
+                jnp.asarray(adm.row[:, lo:lo + c]),
+                jnp.asarray(adm.positions[:, lo:lo + c]),
+                adm.kv_mask,
+            )
         adm.last_logits = logits
         adm.next_chunk += 1
         self._stats["prefill_chunks"] += 1
@@ -1098,10 +1244,14 @@ class DecodeEngine:
             ids_np = np.zeros((1, self.t_ids), np.int32)
             ids_np[0, : len(req["ids"])] = req["ids"]
             extra = (jnp.asarray(ids_np),)
-        self._dstate = self._insert_fn()(
-            self._dstate, adm.cache, adm.last_logits,
-            jnp.asarray(row_presence), jnp.asarray(packed), *extra,
-        )
+        with self.recorder.span(
+            "insert", track="engine.loop", slot=slot,
+            rid=req.get("rid", 0),
+        ):
+            self._dstate = self._insert_fn()(
+                self._dstate, adm.cache, adm.last_logits,
+                jnp.asarray(row_presence), jnp.asarray(packed), *extra,
+            )
         self._host[slot] = _Slot(
             req,
             cursor=s_bucket,
@@ -1121,19 +1271,34 @@ class DecodeEngine:
         if req["stream"] is not None:
             req["stream"].put(None)
         if error is not None:
+            if req.get("rid"):
+                self.recorder.async_end(
+                    "request", req["rid"], cat="req", error=True,
+                )
             _fail_future(req["future"], error)
             return
         now = time.perf_counter()
+        if req.get("rid"):
+            self.recorder.async_end(
+                "request", req["rid"], cat="req",
+                tokens=len(sl.emitted),
+            )
         if sl.t_first is not None and not req.get("warmup"):
             # latency reservoirs behind the stats() percentiles: TTFT
             # is submit -> first token at the HOST (includes queueing,
             # admission, and any pipeline lag — what a client sees);
             # per-token is the mean decode interval after it (needs a
             # second token to exist)
-            self._lat_ttft.append((sl.t_first - req["t_submit"]) * 1e3)
+            ttft_ms = (sl.t_first - req["t_submit"]) * 1e3
+            self._lat_ttft.append(ttft_ms)
+            self._lat_ttft_n += 1
+            self._hist_ttft.observe(ttft_ms)
             n = len(sl.emitted)
             if n > 1:
-                self._lat_tok.append((now - sl.t_first) * 1e3 / (n - 1))
+                tok_ms = (now - sl.t_first) * 1e3 / (n - 1)
+                self._lat_tok.append(tok_ms)
+                self._lat_tok_n += 1
+                self._hist_tok.observe(tok_ms)
         result = {
             "ids": [t for t, _ in sl.emitted],
             "latency_ms": round((now - req["t_submit"]) * 1e3, 2),
@@ -1157,15 +1322,26 @@ class DecodeEngine:
         to resolve a boundary later.  That gap is the overlap: the
         host's dispatch+unpack work for N runs while the device
         executes N+1."""
-        self._dstate, packed = self._dispatch_fn()(
-            self.variables, self._dstate
-        )
-        self._inflight.append((packed, time.perf_counter()))
+        seq = next(self._dispatch_seq)
+        with self.recorder.span(
+            "issue", track="engine.loop", seq=seq,
+        ):
+            self._dstate, packed = self._dispatch_fn()(
+                self.variables, self._dstate
+            )
+        self._inflight.append((packed, time.perf_counter(), seq))
         p = self._pstats
         p["issued"] += 1
         p["inflight_sum"] += len(self._inflight)
         if len(self._inflight) > p["peak_inflight"]:
             p["peak_inflight"] = len(self._inflight)
+        # the dispatch's LIFETIME (issue -> outputs read) as an async
+        # span: overlapping spans stack in Perfetto, so depth 2 shows
+        # dispatch N+1's span (and its issue) nested inside dispatch
+        # N's — overlap_efficiency, drawn
+        self.recorder.async_begin(
+            "dispatch", seq, cat="disp", inflight=len(self._inflight),
+        )
 
     def _process_oldest(self) -> None:
         """Block on the OLDEST in-flight dispatch's packed outputs and
@@ -1173,13 +1349,20 @@ class DecodeEngine:
         rows.  FIFO processing keeps step numbering, stream order, and
         slot retirement identical to the synchronous loop at any
         pipeline depth."""
-        packed, t_issue = self._inflight.popleft()
+        packed, t_issue, seq = self._inflight.popleft()
         t_block = time.perf_counter()
-        arr = np.asarray(packed)     # (3, K, slots) f32, one transfer
+        # the resolve span's duration IS the blocked wait; the time the
+        # pipeline hid (issue -> block) rides as an arg
+        with self.recorder.span(
+            "resolve", track="engine.loop", seq=seq,
+            hidden_ms=round((t_block - t_issue) * 1e3, 3),
+        ):
+            arr = np.asarray(packed)  # (3, K, slots) f32, one transfer
         t_done = time.perf_counter()
         p = self._pstats
         p["hidden_ms"] += (t_block - t_issue) * 1e3
         p["wait_ms"] += (t_done - t_block) * 1e3
+        self.recorder.async_end("dispatch", seq, cat="disp")
         toks = arr[0].astype(np.int32)
         lps = arr[1]
         valid = arr[2] > 0.5
@@ -1197,6 +1380,10 @@ class DecodeEngine:
                 tok, lp = int(toks[kk, i]), float(lps[kk, i])
                 if sl.t_first is None:
                     sl.t_first = t_done
+                    if sl.req.get("rid"):
+                        self.recorder.async_instant(
+                            "first_token", sl.req["rid"], cat="req",
+                        )
                 sl.emitted.append((tok, lp))
                 if sl.req["stream"] is not None:
                     sl.req["stream"].put({
@@ -1284,8 +1471,13 @@ class DecodeEngine:
                         # retires rows itself, so an in-flight dispatch
                         # on a finished row emits nothing — the host
                         # just learns one boundary later.
-                        while self._inflight:
-                            self._process_oldest()
+                        if self._inflight:
+                            with self.recorder.span(
+                                "join_drain", track="engine.loop",
+                                inflight=len(self._inflight),
+                            ):
+                                while self._inflight:
+                                    self._process_oldest()
                         try:
                             self._start_admission(req)
                         except Exception as e:

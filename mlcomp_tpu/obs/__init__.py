@@ -1,0 +1,16 @@
+"""Observability: dependency-free metrics registry (Prometheus text
+exposition) and the serving flight recorder's metric glue.
+
+``mlcomp_tpu.obs.metrics`` is the only module here; it is stdlib-only
+by design — the serving daemon and report server must be scrapeable
+without a prometheus_client install (the container bakes nothing in).
+"""
+
+from mlcomp_tpu.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+)

@@ -13,6 +13,9 @@ Endpoints (all JSON unless noted):
 - ``GET /api/serving``                      live serve-daemon stats (proxy
   of ``MLCOMP_TPU_SERVE_URL``'s /healthz + prefix-cache /cache/stats
   hit/miss/eviction counters; ``{"configured": false}`` when unset)
+- ``GET /metrics``                          Prometheus text exposition:
+  DAG/task status counts, worker heartbeat ages, plus the proxied
+  serve-daemon stats as scrapeable series (docs/observability.md)
 
 Each request opens its own Store handle (sqlite connections are not
 thread-safe across the ThreadingHTTPServer pool; WAL mode makes the
@@ -34,6 +37,7 @@ import json
 import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 
@@ -469,6 +473,20 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._token_ok():
             self._json({"error": "invalid or missing token"}, code=403)
             return
+        if path == "/metrics":
+            # Prometheus text, not JSON — rendered outside _dispatch
+            from mlcomp_tpu.obs.metrics import CONTENT_TYPE
+
+            store = Store(self.db_path)
+            try:
+                body = self._render_metrics(store).encode()
+            except Exception as e:
+                self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+                return
+            finally:
+                store.close()
+            self._send(200, body, CONTENT_TYPE)
+            return
         self._dispatch(_ROUTES)
 
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
@@ -581,6 +599,115 @@ class _Handler(BaseHTTPRequestHandler):
         except (urllib.error.URLError, OSError, ValueError):
             out["prefix_cache"] = None  # daemon runs without the cache
         return out
+
+    def _render_metrics(self, store: Store) -> str:
+        """``GET /metrics``: one Prometheus exposition aggregating the
+        store's DAG/task/worker state with the proxied serve daemon's
+        stats (the same /api/serving payload, re-exposed as scrapeable
+        series) — a single scrape target covers the whole deployment
+        even though workers and the serve daemon have no scrape port
+        of their own."""
+        from mlcomp_tpu.obs.metrics import Registry
+
+        reg = Registry()
+        dag_g = reg.gauge(
+            "mlcomp_report_dags", "DAGs by status", labelnames=("status",)
+        )
+        task_g = reg.gauge(
+            "mlcomp_report_tasks", "Tasks by status across all DAGs",
+            labelnames=("status",),
+        )
+        dag_counts: dict = {}
+        task_counts: dict = {}
+        for d in store.list_dags():
+            dag_counts[d["status"]] = dag_counts.get(d["status"], 0) + 1
+            for s in store.task_statuses(d["id"]).values():
+                task_counts[s.value] = task_counts.get(s.value, 0) + 1
+        for status, n in sorted(dag_counts.items()):
+            dag_g.set(n, status=status)
+        for status, n in sorted(task_counts.items()):
+            task_g.set(n, status=status)
+        now = time.time()
+        alive = 0
+        for w in store.workers():
+            alive += 1 if w["status"] == "alive" else 0
+            labels = {"worker": w["name"]}
+            reg.gauge(
+                "mlcomp_report_worker_heartbeat_age_seconds",
+                "Seconds since the worker's last heartbeat",
+                labelnames=("worker",),
+            ).set(max(0.0, now - float(w["heartbeat"])), **labels)
+            reg.gauge(
+                "mlcomp_report_worker_chips", "Chips the worker advertises",
+                labelnames=("worker",),
+            ).set(w["chips"], **labels)
+            reg.gauge(
+                "mlcomp_report_worker_busy_chips",
+                "Chips pinned to running tasks",
+                labelnames=("worker",),
+            ).set(w["busy_chips"], **labels)
+        reg.gauge(
+            "mlcomp_report_workers_alive", "Workers currently alive"
+        ).set(alive)
+
+        serving = self._r_serving(store)
+        up = reg.gauge(
+            "mlcomp_serving_up",
+            "1 when MLCOMP_TPU_SERVE_URL answers /healthz, 0 when not "
+            "(absent when unconfigured)",
+        )
+        if serving.get("configured"):
+            up.set(1 if serving.get("reachable") else 0)
+        if serving.get("reachable"):
+            health = serving.get("health") or {}
+            eng = health.get("engine") or {}
+
+            def ctr(name, help, value):
+                if value is not None:
+                    reg.counter(name, help).set_total(float(value))
+
+            def gau(name, help, value, **labels):
+                if value is not None:
+                    reg.gauge(
+                        name, help, labelnames=tuple(labels)
+                    ).set(float(value), **labels)
+
+            ctr("mlcomp_serving_requests_total",
+                "Requests the serve daemon has accepted",
+                health.get("requests"))
+            gau("mlcomp_serving_queue_depth",
+                "Requests queued at the daemon", health.get("queue_depth"))
+            ctr("mlcomp_serving_dispatches_total",
+                "Engine decode dispatches", eng.get("dispatches"))
+            ctr("mlcomp_serving_emitted_tokens_total",
+                "Tokens emitted to requests", eng.get("emitted_tokens"))
+            gau("mlcomp_serving_active_slots", "Slots currently decoding",
+                eng.get("active_slots"))
+            lat = serving.get("latency") or {}
+            ctr("mlcomp_serving_latency_samples_total",
+                "Requests behind the latency percentiles (lifetime)",
+                lat.get("lifetime_samples"))
+            for key in ("ttft_ms", "per_token_ms"):
+                pcts = lat.get(key) or {}
+                for q in ("p50", "p95", "p99"):
+                    gau(f"mlcomp_serving_{key.replace('_ms', '')}_ms",
+                        f"Serve daemon {key} percentile (windowed)",
+                        pcts.get(q), quantile=q)
+            pl = serving.get("pipeline") or {}
+            gau("mlcomp_serving_pipeline_overlap_efficiency",
+                "Host ms hidden / host ms total at the engine",
+                pl.get("overlap_efficiency"))
+            gau("mlcomp_serving_pipeline_occupancy",
+                "Mean in-flight dispatch depth at issue",
+                pl.get("occupancy"))
+            pc = serving.get("prefix_cache") or {}
+            ctr("mlcomp_serving_prefix_cache_hits_total",
+                "Prefix-cache lookup hits", pc.get("hits"))
+            ctr("mlcomp_serving_prefix_cache_misses_total",
+                "Prefix-cache lookup misses", pc.get("misses"))
+            gau("mlcomp_serving_prefix_cache_bytes",
+                "Prefix-cache resident bytes", pc.get("bytes"))
+        return reg.render()
 
     def _r_models(self, store: Store):
         """Read-only walk of the ModelStorage tree (project/dag/task) —

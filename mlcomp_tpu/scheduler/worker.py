@@ -401,7 +401,47 @@ class Worker:
             )
         except (OSError, KeyError, ValueError):
             pass
+        self._publish_metrics(info)
         return info
+
+    def _publish_metrics(self, info: Dict[str, Any]) -> None:
+        """Mirror the heartbeat's host signals into the process-default
+        metrics registry (mlcomp_tpu/obs): an embedding process renders
+        them with ``default_registry().render()``, and the report
+        server's /metrics aggregates the same signals fleet-wide from
+        the store.  Best-effort — a metrics hiccup must never stall a
+        heartbeat (the supervisor's reaper feeds on those)."""
+        try:
+            from mlcomp_tpu.obs.metrics import default_registry
+
+            m = default_registry()
+            lbl = {"worker": self.name}
+            m.counter(
+                "mlcomp_worker_heartbeats_total",
+                "Heartbeats this worker published",
+                labelnames=("worker",),
+            ).inc(**lbl)
+            m.gauge(
+                "mlcomp_worker_running_tasks",
+                "Tasks currently executing on this worker",
+                labelnames=("worker",),
+            ).set(len(info.get("tasks", ())), **lbl)
+            m.gauge(
+                "mlcomp_worker_chips", "Chips this worker advertises",
+                labelnames=("worker",),
+            ).set(self.chips, **lbl)
+            if "load1" in info:
+                m.gauge(
+                    "mlcomp_worker_load1", "Host 1-minute load average",
+                    labelnames=("worker",),
+                ).set(info["load1"], **lbl)
+            if "mem_free_gb" in info:
+                m.gauge(
+                    "mlcomp_worker_mem_free_gb", "Host available RAM (GB)",
+                    labelnames=("worker",),
+                ).set(info["mem_free_gb"], **lbl)
+        except Exception:
+            pass
 
     def _heartbeat_pump(
         self, busy_chips: int, stop: threading.Event, task_id: int

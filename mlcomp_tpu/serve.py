@@ -51,6 +51,14 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
                         "engine": {..., "pipeline": overlap metrics}}
     GET  /cache/stats -> prefix-cache hit/miss/eviction/byte counters
         (404 unless the service was built with ``prefix_cache=True``)
+    GET  /metrics   -> Prometheus text exposition (mlcomp_tpu/obs):
+        engine dispatch/pipeline counters, TTFT/per-token histograms,
+        prefix-cache counters — scrape-ready (docs/observability.md)
+    GET  /trace?last_ms=N -> the engine flight recorder's Chrome
+        trace-event JSON (Perfetto-loadable): dispatch issue/resolve
+        spans, in-flight dispatch async spans, prefill chunks,
+        prefix-cache lookups/captures, per-request lifecycle spans
+        (404 for batchers without a drive loop to record)
 
 ``MLCOMP_TPU_SERVE_TOKEN`` (optional) demands ``Authorization: Bearer``
 on every route, mirroring the report server's auth.
@@ -137,9 +145,11 @@ class GenerationService:
         prefix_cache: bool = False,
         prefix_cache_bytes: int = 1 << 31,
         engine_pipeline_depth: Optional[int] = None,
+        flight_recorder_events: Optional[int] = 32768,
     ):
         import jax
 
+        from mlcomp_tpu.obs.metrics import Registry
         from mlcomp_tpu.ops.quant import quantize_params
 
         self.model = model
@@ -228,6 +238,12 @@ class GenerationService:
         self._queue: "queue.Queue" = queue.Queue()
         self._deferred: List[Dict[str, Any]] = []  # batcher thread only
         self._stats = {"requests": 0, "batches": 0, "batched_rows": 0}
+        # the scrape registry behind GET /metrics: the engine (and its
+        # prefix cache) register collectors into it below; the service
+        # contributes its own batcher counters — one exposition per
+        # daemon, whatever the batcher
+        self.metrics = Registry()
+        self.metrics.register_collector(self._collect_metrics)
         self._stop = threading.Event()
         # batcher selection: "continuous" (default, mesh or not) =
         # token-granularity slot engine (mlcomp_tpu/engine.py): requests
@@ -344,6 +360,8 @@ class GenerationService:
                 spec_k=engine_spec_k,
                 prefix_cache=self.prefix_cache,
                 pipeline_depth=engine_pipeline_depth,
+                flight_recorder_events=flight_recorder_events,
+                metrics=self.metrics,
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous
@@ -584,6 +602,48 @@ class GenerationService:
         if self.prefix_cache is None:
             return None
         return self.prefix_cache.stats()
+
+    def _collect_metrics(self) -> None:
+        """Scrape-time collector for the service-level counters (the
+        engine registers its own; window/speculative batchers have
+        only these)."""
+        m = self.metrics
+        st = self._stats
+        m.gauge(
+            "mlcomp_service_info",
+            "Service configuration (value is always 1)",
+            labelnames=("batcher", "quantize"),
+        ).set(1, batcher=self.batcher, quantize=str(self.quant_mode))
+        m.counter(
+            "mlcomp_service_batches_total",
+            "Batches run (window/speculative batchers)",
+        ).set_total(st["batches"])
+        m.counter(
+            "mlcomp_service_batched_rows_total",
+            "Request rows across those batches",
+        ).set_total(st["batched_rows"])
+        if self.engine is None:
+            # continuous mode: the engine collector owns requests and
+            # queue depth (submit() skips the service-level counter)
+            m.counter(
+                "mlcomp_service_requests_total",
+                "Requests submitted (window/speculative batchers)",
+            ).set_total(st["requests"])
+            m.gauge(
+                "mlcomp_service_queue_depth",
+                "Requests waiting for a batch",
+            ).set(self._queue.qsize() + len(self._deferred))
+
+    def trace(self, last_ms: Optional[float] = None) -> Dict[str, Any]:
+        """The engine flight recorder's Chrome-trace export (behind
+        GET /trace).  Raises for batchers without a drive loop to
+        record — the HTTP layer maps that to a 404."""
+        if self.engine is None:
+            raise ValueError(
+                "the flight recorder needs the continuous batcher; "
+                f"this service runs the {self.batcher} batcher"
+            )
+        return self.engine.recorder.export(last_ms=last_ms)
 
     def close(self) -> None:
         self._stop.set()
@@ -966,15 +1026,15 @@ def resolve_storage_ckpt(project: str, dag_name: str, task: str) -> str:
 # ------------------------------------------------------------------ HTTP
 
 
-def serve_http(
+def make_http_server(
     service: GenerationService,
     host: str = "127.0.0.1",
     port: int = 8900,
     model_name: str = "model",
-):
-    """Blocking HTTP front end (stdlib, threaded — handler threads wait
-    on the batcher's futures, which is exactly what gives concurrent
-    requests a shared batch)."""
+) -> "ThreadingHTTPServer":
+    """Build (without starting) the daemon's HTTP server — the
+    non-blocking half of ``serve_http``, reused by tests and
+    tools/obs_check.py on an ephemeral port."""
     import hmac
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -1000,11 +1060,44 @@ def serve_http(
         def do_GET(self):  # noqa: N802
             if not self._token_ok():
                 return self._json({"error": "invalid or missing token"}, 403)
-            route = self.path.split("?", 1)[0]
+            route, _, query = self.path.partition("?")
             if route == "/healthz":
                 return self._json(
                     {"ok": True, "model": model_name, **service.stats()}
                 )
+            if route == "/metrics":
+                from mlcomp_tpu.obs.metrics import CONTENT_TYPE
+
+                body = service.metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
+            if route == "/trace":
+                from urllib.parse import parse_qs
+
+                if service.engine is None:
+                    return self._json(
+                        {"error": "the flight recorder needs the "
+                         "continuous batcher; this service runs the "
+                         f"{service.batcher} batcher"}, 404,
+                    )
+                try:
+                    qs = parse_qs(query)
+                    last_ms = None
+                    if qs.get("last_ms"):
+                        last_ms = float(qs["last_ms"][0])
+                        if last_ms <= 0:
+                            raise ValueError(
+                                f"last_ms must be positive, got {last_ms}"
+                            )
+                    return self._json(service.trace(last_ms=last_ms))
+                except ValueError as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 400
+                    )
             if route == "/cache/stats":
                 stats = service.cache_stats()
                 if stats is None:
@@ -1082,7 +1175,19 @@ def serve_http(
             except Exception as e:
                 return self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 
-    httpd = ThreadingHTTPServer((host, port), Handler)
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_http(
+    service: GenerationService,
+    host: str = "127.0.0.1",
+    port: int = 8900,
+    model_name: str = "model",
+):
+    """Blocking HTTP front end (stdlib, threaded — handler threads wait
+    on the batcher's futures, which is exactly what gives concurrent
+    requests a shared batch)."""
+    httpd = make_http_server(service, host, port, model_name)
     print(json.dumps({
         "event": "serving", "host": host, "port": port,
         "model": model_name, **service.stats(),

@@ -8,7 +8,11 @@ lacks:
   aware) that serializes to Chrome trace-event JSON, viewable in
   ``chrome://tracing`` / Perfetto.  The Trainer wraps epochs, data loading
   and step dispatch in spans when ``cfg["trace"]`` is set; executors can
-  add their own via ``get_tracer()``.
+  add their own via ``get_tracer()``.  With ``max_events`` set the
+  recorder becomes a bounded RING: the newest N events are kept and the
+  oldest silently evicted (``dropped`` counts them) — the always-on
+  flight-recorder mode the serving engine runs, exportable on demand via
+  ``export(last_ms=...)`` (``GET /trace`` on the serve daemon).
 - ``device_profile`` — a context manager around ``jax.profiler`` tracing,
   producing a TensorBoard-loadable device profile (XLA op timeline, HBM
   usage) for the hot path.  Host spans tell you WHERE time goes between
@@ -17,6 +21,17 @@ lacks:
 Host spans deliberately measure *dispatch* time under JAX's async
 execution: a long ``step`` span means the host blocked (queue full, sync
 fetch) — itself a signal.  Use ``device_profile`` for on-chip truth.
+
+Track model: every event carries the recording thread's id, so worker
+threads show as separate Perfetto tracks for free.  Named logical
+tracks (``track="engine.loop"``) map to small synthetic tids with a
+``thread_name`` metadata record emitted at export time — the engine's
+dispatch/admission/prefix-cache spans group visually without depending
+on which real thread ran them.  Async begin/instant/end events
+(``async_begin``/``async_instant``/``async_end``) correlate by
+``(cat, id)`` and may OVERLAP — Perfetto stacks them, which is exactly
+how the dispatch pipeline's in-flight depth becomes visible (dispatch
+N+1's span starts inside dispatch N's at depth 2).
 """
 
 from __future__ import annotations
@@ -25,6 +40,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
@@ -33,78 +49,184 @@ class Tracer:
     """Span recorder emitting Chrome trace-event format.
 
     Thread-safe: spans carry the recording thread's id, so worker threads
-    (data prefetch, heartbeat) show as separate tracks.
+    (data prefetch, heartbeat) show as separate tracks.  ``max_events``
+    bounds memory as a ring buffer (flight-recorder mode); unset keeps
+    the original grow-forever list for short traced runs.
     """
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 max_events: Optional[int] = None):
         self.path = path
-        self._events: List[Dict[str, Any]] = []
+        self.max_events = int(max_events) if max_events else None
+        if self.max_events is not None and self.max_events < 1:
+            raise ValueError(f"max_events must be >= 1, got {max_events}")
+        self._events: "deque | List[Dict[str, Any]]" = (
+            deque(maxlen=self.max_events) if self.max_events else []
+        )
+        self._dropped = 0
+        self._tracks: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def _tid(self, track: Optional[str]) -> int:
+        """Real thread id, or the named logical track's synthetic tid
+        (small ints; pthread idents are pointer-sized, so they cannot
+        collide in practice).  Caller holds the lock."""
+        if track is None:
+            return threading.get_ident()
+        tid = self._tracks.get(track)
+        if tid is None:
+            tid = self._tracks[track] = len(self._tracks) + 1
+        return tid
+
+    def _append(self, ev: Dict[str, Any], track: Optional[str]) -> None:
+        with self._lock:
+            ev["pid"] = os.getpid()
+            ev["tid"] = self._tid(track)
+            if (self.max_events is not None
+                    and len(self._events) == self.max_events):
+                self._dropped += 1  # deque evicts the oldest on append
+            self._events.append(ev)
+
     @contextmanager
-    def span(self, name: str, **args):
+    def span(self, name: str, track: Optional[str] = None, **args):
+        """Complete ("X") span around the with-block.  Yields the args
+        dict so the body can attach results (they serialize at exit):
+
+            with tracer.span("prefix_cache.lookup", prompt=n) as sp:
+                sp["hit_tokens"] = hit
+        """
         start = self._now_us()
         try:
-            yield self
+            yield args
         finally:
             end = self._now_us()
-            with self._lock:
-                self._events.append(
-                    {
-                        "name": name,
-                        "ph": "X",
-                        "ts": start,
-                        "dur": end - start,
-                        "pid": os.getpid(),
-                        "tid": threading.get_ident(),
-                        "args": args,
-                    }
-                )
-
-    def instant(self, name: str, **args) -> None:
-        with self._lock:
-            self._events.append(
+            self._append(
                 {
                     "name": name,
-                    "ph": "i",
-                    "ts": self._now_us(),
-                    "s": "t",
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident(),
+                    "ph": "X",
+                    "ts": start,
+                    "dur": end - start,
                     "args": args,
-                }
+                },
+                track,
             )
+
+    def instant(self, name: str, track: Optional[str] = None,
+                **args) -> None:
+        self._append(
+            {"name": name, "ph": "i", "ts": self._now_us(), "s": "t",
+             "args": args},
+            track,
+        )
 
     def counter(self, name: str, values: Dict[str, float]) -> None:
         """Counter track (e.g. loss over time) rendered as a graph."""
-        with self._lock:
-            self._events.append(
-                {
-                    "name": name,
-                    "ph": "C",
-                    "ts": self._now_us(),
-                    "pid": os.getpid(),
-                    "args": {k: float(v) for k, v in values.items()},
-                }
-            )
+        self._append(
+            {"name": name, "ph": "C", "ts": self._now_us(),
+             "args": {k: float(v) for k, v in values.items()}},
+            None,
+        )
+
+    # -- async (overlapping) events: correlate by (cat, id) -----------
+
+    def _async(self, ph: str, name: str, aid, cat: str,
+               track: Optional[str], args: Dict[str, Any]) -> None:
+        self._append(
+            {"name": name, "ph": ph, "cat": cat, "id": str(aid),
+             "ts": self._now_us(), "args": args},
+            track,
+        )
+
+    def async_begin(self, name: str, aid, cat: str = "async",
+                    track: Optional[str] = None, **args) -> None:
+        self._async("b", name, aid, cat, track, args)
+
+    def async_instant(self, name: str, aid, cat: str = "async",
+                      track: Optional[str] = None, **args) -> None:
+        self._async("n", name, aid, cat, track, args)
+
+    def async_end(self, name: str, aid, cat: str = "async",
+                  track: Optional[str] = None, **args) -> None:
+        self._async("e", name, aid, cat, track, args)
+
+    # -- export --------------------------------------------------------
 
     @property
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)
 
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def export(self, last_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Chrome trace JSON body (Perfetto-loadable).  ``last_ms``
+        keeps only events whose span intersects the trailing window —
+        the flight-recorder fetch ("what just happened") without
+        shipping the whole ring."""
+        with self._lock:
+            evs = list(self._events)
+            tracks = dict(self._tracks)
+            dropped = self._dropped
+        if last_ms is not None:
+            cutoff = self._now_us() - float(last_ms) * 1e3
+            kept = [
+                e for e in evs
+                if e["ts"] + e.get("dur", 0.0) >= cutoff
+            ]
+            # async begins carry no duration, so the intersection test
+            # above would clip the "b" of any span still open at the
+            # cutoff — and Perfetto cannot draw a span from an
+            # unmatched end.  Re-admit pre-cutoff begins whose span is
+            # either still open (no "e" anywhere in the ring) or whose
+            # end/instants made the window.
+            kept_ids = {
+                (e.get("cat"), e.get("id"))
+                for e in kept if e["ph"] in ("e", "n")
+            }
+            ended = {
+                (e.get("cat"), e.get("id"))
+                for e in evs if e["ph"] == "e"
+            }
+            evs = [
+                e for e in evs
+                if e["ph"] == "b" and e["ts"] < cutoff and (
+                    (e.get("cat"), e.get("id")) in kept_ids
+                    or (e.get("cat"), e.get("id")) not in ended
+                )
+            ] + kept
+        pid = os.getpid()
+        meta = [
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": track}}
+            for track, tid in sorted(tracks.items(), key=lambda kv: kv[1])
+        ]
+        return {
+            "traceEvents": meta + evs,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "dropped_events": dropped,
+                "max_events": self.max_events,
+            },
+        }
+
     def save(self, path: Optional[str] = None) -> str:
-        """Write Chrome trace JSON; returns the path written."""
+        """Write Chrome trace JSON; returns the path written.  The
+        event list is SNAPSHOTTED under the lock (``export``) before
+        serialization — ``json.dump`` over the live list raced
+        concurrent ``span()`` appends ("deque/list mutated during
+        iteration")."""
         path = path or self.path
         if not path:
             raise ValueError("no trace path configured")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with self._lock:
-            body = {"traceEvents": self._events, "displayTimeUnit": "ms"}
+        body = self.export()
         with open(path, "w") as f:
             json.dump(body, f)
         return path
@@ -117,13 +239,17 @@ class _NullTracer(Tracer):
         super().__init__()
 
     @contextmanager
-    def span(self, name: str, **args):
-        yield self
+    def span(self, name: str, track: Optional[str] = None, **args):
+        yield args
 
-    def instant(self, name: str, **args) -> None:
+    def instant(self, name: str, track: Optional[str] = None,
+                **args) -> None:
         pass
 
     def counter(self, name: str, values: Dict[str, float]) -> None:
+        pass
+
+    def _async(self, ph, name, aid, cat, track, args) -> None:
         pass
 
     def save(self, path: Optional[str] = None) -> str:
@@ -132,6 +258,12 @@ class _NullTracer(Tracer):
 
 _NULL = _NullTracer()
 _current: List[Tracer] = []
+
+
+def null_tracer() -> Tracer:
+    """The shared no-op tracer — a default for components that accept
+    an optional recorder (e.g. the prefix cache's capture worker)."""
+    return _NULL
 
 
 def set_tracer(tracer: Optional[Tracer]) -> None:

@@ -1,0 +1,25 @@
+"""Tier-1 wiring of tools/obs_check.py: the serve-path observability
+contract (exposition lint, documented-metric presence, counter
+monotonicity across scrapes, Perfetto-loadable /trace) checked against
+a real toy engine + daemon, like tools/cachecheck.py wires the prefix
+index's fault harness."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+))
+
+import obs_check  # noqa: E402
+
+
+def test_obs_check_end_to_end():
+    out = obs_check.run(n_requests=3)
+    assert out["requests"] == 6          # both traffic phases counted
+    assert out["dispatch_spans"] > 0     # flight recorder saw dispatches
+    assert out["trace_events"] > 0
+
+
+def test_obs_check_cli_entrypoint():
+    assert obs_check.main([]) == 0

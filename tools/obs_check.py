@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Observability smoke harness: serve-path /metrics + /trace, checked.
+
+Spins up a toy continuous engine behind the real serve daemon HTTP
+stack (``serve.make_http_server`` on an ephemeral port, prefix cache
+on), drives real requests through ``POST /generate``, then asserts the
+observability contract the docs promise (docs/observability.md):
+
+- ``GET /metrics`` parses as Prometheus text exposition: every sample
+  line well-formed, every sample family preceded by exactly one
+  ``# TYPE``, histogram ``_bucket`` series cumulative and capped by
+  ``_count``;
+- every DOCUMENTED serve-daemon metric is present (a metric renamed in
+  code but not in docs — or vice versa — fails here, not in a user's
+  dashboard);
+- counters are MONOTONIC across two scrapes with traffic in between,
+  and the traffic actually moved the request counter;
+- ``GET /trace`` returns Chrome trace-event JSON (Perfetto-loadable):
+  dispatch async begin/end pairs balance, issue/resolve spans exist,
+  request lifecycle spans carry matched begin/ends, and ``last_ms``
+  windowing returns a subset.
+
+No TPU needed (CPU jax), finishes in seconds; tests/test_obs_check.py
+wires it into tier-1 like tools/cachecheck.py.  Standalone:
+
+    python tools/obs_check.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the serve-daemon metric families docs/observability.md documents —
+# keep the three in sync (this harness is the enforcement)
+DOCUMENTED_SERVE_METRICS = [
+    "mlcomp_engine_requests_total",
+    "mlcomp_engine_dispatches_total",
+    "mlcomp_engine_steps_total",
+    "mlcomp_engine_emitted_tokens_total",
+    "mlcomp_engine_prefills_total",
+    "mlcomp_engine_prefill_chunks_total",
+    "mlcomp_engine_latency_samples_total",
+    "mlcomp_engine_slots",
+    "mlcomp_engine_active_slots",
+    "mlcomp_engine_queue_depth",
+    "mlcomp_engine_pipeline_depth",
+    "mlcomp_engine_pipeline_inflight",
+    "mlcomp_engine_pipeline_peak_inflight",
+    "mlcomp_engine_pipeline_issued_total",
+    "mlcomp_engine_pipeline_hidden_ms_total",
+    "mlcomp_engine_pipeline_wait_ms_total",
+    "mlcomp_engine_pipeline_overlap_efficiency",
+    "mlcomp_engine_trace_events_dropped_total",
+    "mlcomp_engine_ttft_ms",
+    "mlcomp_engine_per_token_ms",
+    "mlcomp_service_info",
+    "mlcomp_service_batches_total",
+    "mlcomp_service_batched_rows_total",
+    "mlcomp_prefix_cache_lookups_total",
+    "mlcomp_prefix_cache_hits_total",
+    "mlcomp_prefix_cache_misses_total",
+    "mlcomp_prefix_cache_used_hit_tokens_total",
+    "mlcomp_prefix_cache_inserted_tokens_total",
+    "mlcomp_prefix_cache_evictions_total",
+    "mlcomp_prefix_cache_bytes",
+    "mlcomp_prefix_cache_nodes",
+    "mlcomp_prefix_cache_capture_queue_depth",
+]
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.eE+\-]+|\+Inf|NaN)$"
+)
+_LABELS_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """Lint + parse Prometheus text format.  Returns
+    ``(samples, types)``: ``samples`` maps sample name (including
+    ``_bucket``/``_sum``/``_count`` suffixes) -> {labelstring: value},
+    ``types`` maps family name -> type.  Raises AssertionError on any
+    malformed line or a sample without a preceding # TYPE."""
+    samples: dict = {}
+    types: dict = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"malformed TYPE line: {line!r}"
+            name, kind = parts[2], parts[3]
+            assert kind in ("counter", "gauge", "histogram", "untyped"), line
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or family in types, (
+            f"sample {name} has no # TYPE"
+        )
+        if labels:
+            body = labels[1:-1]
+            rebuilt = ",".join(
+                f'{k}="{v}"' for k, v in _LABELS_RE.findall(body)
+            )
+            assert rebuilt == body, f"malformed labels: {labels!r}"
+        v = float(value.replace("+Inf", "inf"))
+        samples.setdefault(name, {})[labels] = v
+    return samples, types
+
+
+def check_histograms(samples, types):
+    """Cumulative-bucket sanity for every histogram family."""
+    for family, kind in types.items():
+        if kind != "histogram":
+            continue
+        buckets = samples.get(f"{family}_bucket", {})
+        counts = samples.get(f"{family}_count", {})
+        assert buckets and counts, f"{family}: empty histogram"
+        # group bucket series by their non-le labels
+        by_group: dict = {}
+        for labels, v in buckets.items():
+            body = labels[1:-1] if labels else ""
+            pairs = dict(_LABELS_RE.findall(body))
+            le = pairs.pop("le")
+            key = tuple(sorted(pairs.items()))
+            by_group.setdefault(key, []).append((le, v))
+        for key, series in by_group.items():
+            inf = [v for le, v in series if le == "+Inf"]
+            assert inf, f"{family}{key}: no +Inf bucket"
+            finite = sorted(
+                ((float(le), v) for le, v in series if le != "+Inf")
+            )
+            last = 0.0
+            for _, v in finite:
+                assert v >= last, f"{family}{key}: non-cumulative buckets"
+                last = v
+            assert inf[0] >= last, f"{family}{key}: +Inf below last bucket"
+
+
+def _counters_monotonic(before, after, types):
+    for family, kind in types.items():
+        if kind != "counter":
+            continue
+        for labels, v0 in before.get(family, {}).items():
+            v1 = after.get(family, {}).get(labels)
+            assert v1 is not None, f"counter {family}{labels} vanished"
+            assert v1 >= v0, (
+                f"counter {family}{labels} went backwards: {v0} -> {v1}"
+            )
+
+
+def run(n_requests: int = 4) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.serve import GenerationService, make_http_server
+    from mlcomp_tpu.train.state import init_model
+
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+        "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32",
+    })
+    prompt = jnp.asarray(np.random.RandomState(0).randint(1, 64, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    # prefill_chunk 8 divides the 16 bucket, so the prefix cache's hit
+    # path (and its metrics) can actually engage on repeated prompts
+    svc = GenerationService(
+        model, {"params": params}, batch_sizes=(1, 2),
+        prompt_buckets=(16,), max_new_buckets=(8,),
+        prefix_cache=True, prefill_chunk=8,
+    )
+    httpd = make_http_server(svc, "127.0.0.1", 0, "obs-check")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+
+    def generate(ids):
+        body = json.dumps(
+            {"prompt": ids, "max_new_tokens": 4}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as r:
+            return json.loads(r.read())
+
+    def get(path):
+        with urllib.request.urlopen(f"{base}{path}", timeout=60) as r:
+            return r.read()
+
+    try:
+        shared = [9, 10, 11, 12, 13, 14, 15, 16, 17]
+        for i in range(n_requests):
+            out = generate(shared + [i + 1])
+            assert len(out["ids"]) == 4, out
+        svc.prefix_cache.flush()
+
+        text1 = get("/metrics").decode()
+        s1, t1 = parse_exposition(text1)
+        check_histograms(s1, t1)
+        missing = [
+            m for m in DOCUMENTED_SERVE_METRICS
+            if m not in t1
+        ]
+        assert not missing, f"documented metrics absent: {missing}"
+        req0 = s1["mlcomp_engine_requests_total"][""]
+
+        for i in range(n_requests):
+            generate(shared + [100 + i])
+        text2 = get("/metrics").decode()
+        s2, t2 = parse_exposition(text2)
+        check_histograms(s2, t2)
+        _counters_monotonic(s1, s2, t1)
+        req1 = s2["mlcomp_engine_requests_total"][""]
+        assert req1 == req0 + n_requests, (req0, req1)
+        assert s2["mlcomp_prefix_cache_hits_total"][""] > 0
+
+        trace = json.loads(get("/trace?last_ms=600000"))
+        evs = trace["traceEvents"]
+        assert isinstance(evs, list) and evs, "empty trace"
+        for e in evs:
+            assert "ph" in e and "pid" in e, e
+        begins = sum(
+            1 for e in evs if e["ph"] == "b" and e["name"] == "dispatch"
+        )
+        ends = sum(
+            1 for e in evs if e["ph"] == "e" and e["name"] == "dispatch"
+        )
+        assert begins and begins == ends, (begins, ends)
+        names = {e["name"] for e in evs}
+        for want in ("issue", "resolve", "request", "first_token",
+                     "prefill_chunk", "insert", "prefix_cache.lookup"):
+            assert want in names, f"missing trace span {want!r}"
+        # last_ms windows: a zero-width trailing window drops the
+        # decode-time events the full fetch carried
+        tiny = json.loads(get("/trace?last_ms=0.001"))
+        assert len(tiny["traceEvents"]) <= len(evs)
+        return {
+            "requests": int(req1),
+            "metric_families": len(t2),
+            "trace_events": len(evs),
+            "dispatch_spans": begins,
+        }
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()
+
+
+def main(argv=None) -> int:
+    out = run()
+    print(f"ok: {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
