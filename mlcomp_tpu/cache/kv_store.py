@@ -246,7 +246,13 @@ class PrefixKVCache:
             ]
 
     def lookup(self, ids):
-        """Pinned longest-prefix lease for ``ids`` (or None)."""
+        """Pinned longest-prefix lease for ``ids`` (or None).  The
+        fault point is the chaos surface tools/chaoscheck.py drives:
+        an armed raise here must be CONTAINED by the engine to a
+        cache-bypass (degraded mode), never a failed request."""
+        from mlcomp_tpu.utils.faults import inject
+
+        inject("cache.lookup")
         return self.index.lookup(ids)
 
     def assemble(self, lease, width: int, start_pad: int,
@@ -308,6 +314,11 @@ class PrefixKVCache:
                 return
             capture_call, cache, ids, start_pad, lo = item
             try:
+                # chaos surface: an armed raise lands in the except
+                # below (insert_errors — serving continues uncached)
+                from mlcomp_tpu.utils.faults import inject
+
+                inject("cache.capture")
                 # device->host fetch + host copies + trie insert, off
                 # the engine loop thread — spanned so a slow capture
                 # shows up on the worker's track, not as engine stall

@@ -83,6 +83,7 @@ class Lease:
             if self._released:
                 return
             self._released = True
+            index._leases -= 1
             for node in self._nodes:
                 node.refs -= 1
                 if node.refs == 0:
@@ -114,6 +115,11 @@ class PrefixIndex:
         # thread's admissions need
         self._nodes = 0
         self._pinned = 0
+        # unreleased Lease count — the caller-facing leak unit behind
+        # the chaoscheck invariant that no engine fault path leaks a
+        # pin (distinct leases can share pinned nodes, so pinned_nodes
+        # alone under-counts outstanding leases)
+        self._leases = 0
         self._clock = 0  # monotonic LRU tick (time.monotonic ties on fast ops)
         self.counters = {
             "lookups": 0, "hits": 0, "misses": 0, "matched_tokens": 0,
@@ -155,6 +161,7 @@ class PrefixIndex:
                 if n.refs == 1:
                     self._pinned += 1
                 n.last_used = self._clock
+            self._leases += 1
             return Lease(self, nodes, segments, matched)
 
     def insert(self, ids, block, offset: int = 0) -> int:
@@ -241,6 +248,7 @@ class PrefixIndex:
                 "max_bytes": self.max_bytes,
                 "nodes": self._nodes,
                 "pinned_nodes": self._pinned,
+                "outstanding_leases": self._leases,
             }
 
     def check_invariants(self) -> None:

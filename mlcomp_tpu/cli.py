@@ -362,6 +362,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         prefix_cache=args.prefix_cache,
         prefix_cache_bytes=args.prefix_cache_bytes,
         flight_recorder_events=args.flight_recorder_events,
+        request_timeout_s=args.request_timeout,
+        max_queue_depth=args.max_queue_depth,
+        max_concurrent_requests=args.max_concurrent_requests,
+        dispatch_stall_timeout=args.dispatch_stall_timeout or None,
     )
     if args.warmup:
         n = service.warmup()
@@ -644,6 +648,37 @@ def main(argv=None) -> int:
         " trace JSON; GET /metrics is always on).  0 disables recording"
         " — measured overhead is <1%% of dispatch wall (bench.py's"
         " recorder A/B), so the default stays on",
+    )
+    sv.add_argument(
+        "--request-timeout", type=float, default=600.0,
+        help="per-request wall-clock budget in seconds (default 600,"
+        " the old hardcoded future timeout): every request gets this"
+        " as its default deadline, enforced by the engine at dispatch"
+        " boundaries — expired requests free their slot and fail with"
+        " 504.  Clients may pass a tighter \"deadline_s\" per request"
+        " (larger values clamp to this budget — a slot is shared)",
+    )
+    sv.add_argument(
+        "--max-queue-depth", type=int, default=0,
+        help="continuous batcher: bound on requests waiting for a slot"
+        " — past it submits fast-fail with 429 + Retry-After derived"
+        " from live per-token latency, instead of queueing unboundedly"
+        " (0 = unbounded, the historical behavior)",
+    )
+    sv.add_argument(
+        "--max-concurrent-requests", type=int, default=0,
+        help="continuous batcher: bound on total in-flight requests"
+        " (queued + decoding); past it submits fast-fail with 429"
+        " (0 = unbounded)",
+    )
+    sv.add_argument(
+        "--dispatch-stall-timeout", type=float, default=300.0,
+        help="continuous batcher: watchdog threshold in seconds — a"
+        " dispatch stuck in the runtime longer than this fails the"
+        " in-flight requests, flips /healthz to 503, and (once the"
+        " drive loop is provably dead) attempts one bounded restart."
+        " Set well above your slowest legitimate dispatch (compile"
+        " stalls count!); 0 disables the watchdog",
     )
     sv.add_argument("--warmup", action="store_true",
                     help="precompile the hot buckets before listening")

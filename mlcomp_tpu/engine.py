@@ -70,6 +70,25 @@ decode_attention.sharded_decode_attention — they read the process
 mesh, which ``serve.load_service`` installs).  The host drives the
 same numpy knob rows; under SPMD they replicate.
 
+Resilience layer (this PR): failure behavior is defined, not
+emergent.  Every request may carry a deadline and a cancel handle
+(``submit(..., deadline_s=...)``, ``cancel(rid)``) — the loop retires
+expired/cancelled requests at the next dispatch boundary (queued ones
+fail in place, active rows are deactivated ON DEVICE and free their
+slot), so a stuck client or an abandoned stream never holds a slot
+past one boundary.  A raise inside the loop fails every in-flight and
+queued future with the error and the thread dies CLEANLY; the
+watchdog thread (``dispatch_stall_timeout``) detects both that death
+and a dispatch wedged in the runtime (busy-clock timeout: waiters are
+failed host-side with ``EngineStalled`` in bounded time), marks the
+engine unhealthy (serve's /healthz 503), and performs one bounded,
+progress-gated restart on a fresh device carry.  Prefix-cache faults
+are contained to a cache-bypass (degraded mode), never a failed
+request.  The fault points live in utils/faults.py;
+tools/chaoscheck.py drives a live daemon through each and asserts
+recovery invariants, and bench.py's resilience A/B gates the
+per-boundary maintenance under 1% of dispatch wall.
+
 No upstream analog: the reference framework has no serving path at all.
 """
 
@@ -86,9 +105,33 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from mlcomp_tpu.utils.faults import inject as _inject_fault
 from mlcomp_tpu.utils.trace import Tracer, null_tracer
 
 _POISON = object()  # close() wakes a blocked queue.get with this
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's ``deadline_s`` passed before it finished; it was
+    retired at the next dispatch boundary.  HTTP maps this to 504."""
+
+    status = "deadline_exceeded"
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled (``cancel(rid)`` — e.g. the HTTP
+    client disconnected) and retired at the next dispatch boundary."""
+
+    status = "cancelled"
+
+
+class EngineStalled(RuntimeError):
+    """The watchdog declared a dispatch wedged (it exceeded
+    ``dispatch_stall_timeout``) or found the drive loop dead; in-flight
+    requests fail with this, distinguishable from a plain engine
+    error."""
+
+    status = "engine_stalled"
 
 
 def _fail_future(fut: Future, err: Exception) -> None:
@@ -99,6 +142,17 @@ def _fail_future(fut: Future, err: Exception) -> None:
         if not fut.done():
             fut.set_exception(err)
     except Exception:  # InvalidStateError: the other side resolved it
+        pass
+
+
+def _set_result(fut: Future, result) -> None:
+    """Resolve a future idempotently: the watchdog may have failed it
+    already (stall declared, then the wedged dispatch returned and the
+    loop finished the row normally) — the watchdog's verdict stands."""
+    try:
+        if not fut.done():
+            fut.set_result(result)
+    except Exception:  # InvalidStateError: lost the race
         pass
 
 
@@ -175,6 +229,7 @@ class DecodeEngine:
         pipeline_depth: Optional[int] = None,
         flight_recorder_events: Optional[int] = 32768,
         metrics=None,
+        dispatch_stall_timeout: Optional[float] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -337,48 +392,31 @@ class DecodeEngine:
                 variables = dequantize_params(variables, jnp.bfloat16)
         self.variables = jax.tree.map(jnp.asarray, variables)
 
-        from mlcomp_tpu.models.generation import init_cache
-
-        # ALL decode state lives on device and is carried (donated)
-        # through the dispatch/insert programs: a steady-state dispatch
-        # is ONE device call plus ONE packed output fetch — no per-step
-        # knob-row uploads, no host-side rng split.  (Measured through
-        # the tunnel: the round-4 engine's ~10 small host->device
-        # transfers per step cost ~30 ms EACH through the tunnel and a
-        # syscall each even directly-attached; carrying the state cuts
-        # a dispatch to a single call.)  The host keeps a _Slot mirror
-        # purely for bookkeeping (futures, streams, emitted tokens).
-        ns = self.slots
-        self._dstate = {
-            "cache": init_cache(model, ns, self.l_buf),
-            "last_logits": jnp.zeros((ns, self.vocab), jnp.float32),
-            "presence": jnp.zeros((ns, self.vocab), jnp.bool_),
-            "cursors": jnp.zeros((ns,), jnp.int32),
-            "kv_start": jnp.zeros((ns,), jnp.int32),
-            "positions": jnp.zeros((ns,), jnp.int32),
-            "active": jnp.zeros((ns,), jnp.bool_),
-            "remaining": jnp.zeros((ns,), jnp.int32),
-            "eos": jnp.full((ns,), -1, jnp.int32),
-            "t": jnp.zeros((ns,), jnp.float32),
-            "k": jnp.full((ns,), self.vocab, jnp.int32),
-            "p": jnp.ones((ns,), jnp.float32),
-            "rp": jnp.ones((ns,), jnp.float32),
-            "rng": jax.random.PRNGKey(seed),
-        }
         if self.spec_k is not None:
             # device-carried token history per slot (left-aligned real
             # ids, no bucket pads): the n-gram draft's source
             self.t_ids = self.prompt_buckets[-1] + self.max_new_cap
-            self._dstate["ids"] = jnp.zeros((ns, self.t_ids), jnp.int32)
-            self._dstate["ids_len"] = jnp.zeros((ns,), jnp.int32)
+        self._seed = int(seed)
+        self._dstate = self._fresh_dstate()
         self._host: List[Optional[_Slot]] = [None] * self.slots
         self._adm: Optional[_Admission] = None
         self._broken: Optional[Exception] = None
         self._abandoned = False
         self._queue: "queue.Queue" = queue.Queue()
+        # loop-owned admission order: submit() enqueues into _queue (the
+        # thread-safe handoff); the loop pumps it into _pending, where
+        # deadline/cancel sweeps can retire QUEUED requests at a
+        # dispatch boundary instead of only when a slot frees up
+        self._pending: Deque[Dict[str, Any]] = deque()
+        # rids cancelled via cancel() but not yet retired by the loop's
+        # boundary sweep (set add/discard are atomic under the GIL; the
+        # sweep runs on the loop thread)
+        self._cancelled: set = set()
         self._stats = {
             "requests": 0, "steps": 0, "prefills": 0, "dispatches": 0,
             "prefill_chunks": 0, "emitted_tokens": 0,
+            "deadline_exceeded": 0, "cancelled": 0, "cache_degraded": 0,
+            "watchdog_stalls": 0, "watchdog_restarts": 0,
         }
         # issued-but-unprocessed dispatches, oldest first: (packed
         # device buffer, host issue time, dispatch seq — the flight
@@ -439,8 +477,71 @@ class DecodeEngine:
         self.step_count = 0
         self._fns: Dict[Any, Any] = {}
         self._stop = threading.Event()
+        # watchdog state: _busy_since marks the host time the loop
+        # thread entered a potentially-wedging call (dispatch issue,
+        # output resolve, prefill chunk, insert); the monitor thread
+        # declares a stall when it exceeds dispatch_stall_timeout.
+        # _exit_loop asks the loop to die cleanly at its next boundary
+        # (set by the watchdog after a stall so the restart path sees a
+        # dead thread, never two live loops).
+        self.dispatch_stall_timeout = (
+            float(dispatch_stall_timeout)
+            if dispatch_stall_timeout else None
+        )
+        self._busy_since: Optional[float] = None
+        self._exit_loop = threading.Event()
+        self._unhealthy_reason: Optional[str] = None
+        # restart budget: one attempt per incident, but only if the
+        # engine made progress (resolved a dispatch) since the last
+        # restart — a crash loop stays down instead of flapping
+        self._dispatches_at_restart: Optional[int] = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        self._watchdog: Optional[threading.Thread] = None
+        if self.dispatch_stall_timeout is not None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="engine-watchdog",
+            )
+            self._watchdog.start()
+
+    def _fresh_dstate(self) -> Dict[str, Any]:
+        """ALL decode state lives on device and is carried (donated)
+        through the dispatch/insert programs: a steady-state dispatch
+        is ONE device call plus ONE packed output fetch — no per-step
+        knob-row uploads, no host-side rng split.  (Measured through
+        the tunnel: the round-4 engine's ~10 small host->device
+        transfers per step cost ~30 ms EACH through the tunnel and a
+        syscall each even directly-attached; carrying the state cuts
+        a dispatch to a single call.)  The host keeps a _Slot mirror
+        purely for bookkeeping (futures, streams, emitted tokens).
+        Factored out of __init__ so a watchdog restart can rebuild the
+        carry from scratch (a crashed loop may have died mid-donation,
+        leaving the old pytree invalid)."""
+        jax, jnp = self._jax, self._jnp
+        from mlcomp_tpu.models.generation import init_cache
+
+        ns = self.slots
+        dstate = {
+            "cache": init_cache(self.model, ns, self.l_buf),
+            "last_logits": jnp.zeros((ns, self.vocab), jnp.float32),
+            "presence": jnp.zeros((ns, self.vocab), jnp.bool_),
+            "cursors": jnp.zeros((ns,), jnp.int32),
+            "kv_start": jnp.zeros((ns,), jnp.int32),
+            "positions": jnp.zeros((ns,), jnp.int32),
+            "active": jnp.zeros((ns,), jnp.bool_),
+            "remaining": jnp.zeros((ns,), jnp.int32),
+            "eos": jnp.full((ns,), -1, jnp.int32),
+            "t": jnp.zeros((ns,), jnp.float32),
+            "k": jnp.full((ns,), self.vocab, jnp.int32),
+            "p": jnp.ones((ns,), jnp.float32),
+            "rp": jnp.ones((ns,), jnp.float32),
+            "rng": jax.random.PRNGKey(self._seed),
+        }
+        if self.spec_k is not None:
+            dstate["ids"] = jnp.zeros((ns, self.t_ids), jnp.int32)
+            dstate["ids_len"] = jnp.zeros((ns,), jnp.int32)
+        return dstate
 
     # ------------------------------------------------------------- public
 
@@ -455,6 +556,7 @@ class DecodeEngine:
         logprobs: bool = False,
         repetition_penalty: float = 1.0,
         stream: Optional["queue.Queue"] = None,
+        deadline_s: Optional[float] = None,
         _count: bool = True,
     ) -> Future:
         ids = [int(t) for t in prompt_ids]
@@ -485,16 +587,22 @@ class DecodeEngine:
             raise RuntimeError(
                 f"decode engine is down: {self._broken!r}"
             ) from self._broken
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError(
+                f"deadline_s must be positive, got {deadline_s}"
+            )
         fut: Future = Future()
         # request-lifecycle trace: one async span per request
         # (queue -> admit -> first_token -> finish), correlated by rid.
         # Warmup's dummy submissions stay out of the recording like
         # they stay out of every other request-visible counter.
         rid = next(self._rid) if _count else 0
+        fut.rid = rid  # the cancel(rid) handle callers key on
         if rid:
             self.recorder.async_begin(
                 "request", rid, cat="req", prompt=len(ids), n_new=n_new,
             )
+        now = time.perf_counter()
         self._queue.put({
             "ids": ids, "n_new": n_new, "future": fut,
             "temperature": float(temperature),
@@ -504,25 +612,98 @@ class DecodeEngine:
             "logprobs": bool(logprobs),
             "repetition_penalty": float(repetition_penalty),
             "stream": stream,
-            "t_submit": time.perf_counter(),
+            "t_submit": now,
+            # absolute host deadline; the loop retires the request at
+            # the first dispatch boundary past it (None = no deadline)
+            "t_deadline": (
+                None if deadline_s is None else now + float(deadline_s)
+            ),
             "rid": rid,
             # warmup's dummy prompts must not seed (or probe) the prefix
             # cache — they'd pin budget with [1]*bucket junk
             "warmup": not _count,
         })
-        if self._stop.is_set():
-            # close() may have drained the queue between the check above
-            # and our put; resolve the future ourselves (idempotent —
-            # see _fail_future; a duplicate stream None is harmless, the
-            # consumer stops at the first)
+        if self._stop.is_set() or self._broken is not None:
+            # close() (or a dying loop) may have drained the queue
+            # between the checks above and our put; resolve the future
+            # ourselves (idempotent — see _fail_future; a duplicate
+            # stream None is harmless, the consumer stops at the first)
             if stream is not None:
                 stream.put(None)
-            _fail_future(fut, RuntimeError("decode engine closed"))
+            _fail_future(fut, self._broken or RuntimeError(
+                "decode engine closed"
+            ))
         if _count:
             # warmup's dummy submissions pass _count=False so the
             # service-visible request count means real requests only
             self._stats["requests"] += 1
         return fut
+
+    def cancel(self, rid: int) -> bool:
+        """Request cancellation of a live request by its rid (the
+        ``rid`` attribute of the Future ``submit`` returned).  The loop
+        retires it at the next dispatch boundary: queued requests fail
+        without ever taking a slot, in-flight rows free their slot and
+        their future fails with ``RequestCancelled``.  Returns True if
+        the rid matched a live request (best-effort: a request may
+        finish between the scan and the retirement)."""
+        rid = int(rid)
+        if rid <= 0:
+            return False
+
+        def is_live() -> bool:
+            # the loop thread mutates _pending concurrently; a deque
+            # iterated mid-mutation raises RuntimeError — retry, and
+            # if it keeps churning assume live (cancel is best-effort,
+            # and a rare stale rid is discarded by the sweep/finish)
+            for _ in range(3):
+                try:
+                    if any(
+                        sl is not None and sl.req.get("rid") == rid
+                        for sl in self._host
+                    ) or any(
+                        req.get("rid") == rid
+                        for req in list(self._pending)
+                    ):
+                        return True
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                return True
+            adm = self._adm
+            if adm is not None and adm.req.get("rid") == rid:
+                return True
+            with self._queue.mutex:  # not yet pumped out of the queue?
+                return any(
+                    isinstance(r, dict) and r.get("rid") == rid
+                    for r in self._queue.queue
+                )
+
+        if not is_live():
+            return False
+        self._cancelled.add(rid)
+        # close the finish race: if the request completed between the
+        # scan and the add, nothing will ever sweep the rid out (the
+        # loop's discards ran before the add) — rids are never reused,
+        # so a dead rid in the set would defeat the sweep's fast path
+        # forever.  A finish AFTER the add is discarded by _finish /
+        # _fail_queued themselves.
+        if not is_live():
+            self._cancelled.discard(rid)
+            return False
+        return True
+
+    @property
+    def healthy(self) -> bool:
+        """False once the drive loop is broken, abandoned, or dead
+        (until a watchdog restart brings it back) — the bit behind
+        /healthz's 503 and the ``mlcomp_engine_healthy`` gauge."""
+        return (
+            self._broken is None
+            and not self._abandoned
+            and self._thread.is_alive()
+        )
 
     @staticmethod
     def _percentiles(samples) -> Optional[Dict[str, float]]:
@@ -538,11 +719,20 @@ class DecodeEngine:
         active = sum(1 for s in self._host if s is not None)
         out = {
             **self._stats,
-            "queue_depth": self._queue.qsize(),
+            # queued = parked in the submit queue + pumped into the
+            # loop's pending deque but not yet admitted
+            "queue_depth": self._queue.qsize() + len(self._pending),
             "active_slots": active,
             "slots": self.slots,
             "steps_per_dispatch": self.steps_per_dispatch,
             "prefill_chunk": self.prefill_chunk,
+            "healthy": self.healthy,
+        }
+        out["watchdog"] = {
+            "dispatch_stall_timeout_s": self.dispatch_stall_timeout,
+            "stalls": self._stats["watchdog_stalls"],
+            "restarts": self._stats["watchdog_restarts"],
+            "unhealthy_reason": self._unhealthy_reason,
         }
         p = dict(self._pstats)  # snapshot: the loop thread mutates it
         done = self._stats["dispatches"]
@@ -607,11 +797,27 @@ class DecodeEngine:
         ctr("mlcomp_engine_latency_samples_total",
             "Requests behind the TTFT percentiles (lifetime)",
             self._lat_ttft_n)
+        ctr("mlcomp_engine_deadline_exceeded_total",
+            "Requests retired past their deadline",
+            st["deadline_exceeded"])
+        ctr("mlcomp_engine_cancelled_total",
+            "Requests retired by cancel()", st["cancelled"])
+        ctr("mlcomp_cache_degraded_total",
+            "Prefix-cache faults contained to a cache-bypass",
+            st["cache_degraded"])
+        ctr("mlcomp_engine_watchdog_stalls_total",
+            "Watchdog stall/dead-loop detections", st["watchdog_stalls"])
+        ctr("mlcomp_engine_watchdog_restarts_total",
+            "Drive-loop restarts the watchdog performed",
+            st["watchdog_restarts"])
+        gau("mlcomp_engine_healthy",
+            "1 while the drive loop is alive and unbroken, else 0",
+            1 if self.healthy else 0)
         gau("mlcomp_engine_slots", "Configured decode slots", self.slots)
         gau("mlcomp_engine_active_slots", "Slots currently decoding",
             sum(1 for s in self._host if s is not None))
         gau("mlcomp_engine_queue_depth", "Requests waiting for a slot",
-            self._queue.qsize())
+            self._queue.qsize() + len(self._pending))
         p = dict(self._pstats)
         ctr("mlcomp_engine_pipeline_issued_total",
             "Dispatches issued into the pipeline", p["issued"])
@@ -641,7 +847,7 @@ class DecodeEngine:
                 ctr(f"mlcomp_prefix_cache_{key}_total",
                     f"Prefix KV cache {key.replace('_', ' ')}", cs[key])
             for key in ("bytes", "max_bytes", "nodes", "pinned_nodes",
-                        "capture_queue_depth"):
+                        "outstanding_leases", "capture_queue_depth"):
                 gau(f"mlcomp_prefix_cache_{key}",
                     f"Prefix KV cache {key.replace('_', ' ')}", cs[key])
 
@@ -662,24 +868,40 @@ class DecodeEngine:
         self._stop.set()
         self._queue.put(_POISON)  # wake a blocked queue.get NOW
         self._thread.join(timeout=timeout)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5.0)
         if self.prefix_cache is not None:
             # drop queued captures (each pins a full admission cache's
             # device buffers) and stop the cache's worker thread
             self.prefix_cache.close()
         err = RuntimeError("decode engine closed")
         if self._thread.is_alive():
-            # wedged mid-dispatch: do NOT touch state the thread owns
+            # wedged mid-dispatch: force-detach LOUDLY (a silent leak
+            # looked identical to a clean close), then do NOT touch
+            # state the thread owns
             self._abandoned = True
             self._broken = RuntimeError(
                 "decode engine close timed out; step thread abandoned"
             )
+            self._unhealthy_reason = (
+                f"close() join timed out after {timeout}s"
+            )
+            warnings.warn(
+                f"decode engine close(): step thread did not exit "
+                f"within {timeout}s (a dispatch is wedged in the "
+                "runtime); abandoning it — active rows' futures "
+                "resolve only if the dispatch ever returns",
+                stacklevel=2,
+            )
             self._drain_queue(err)
             return
         # thread exited: nobody may be left waiting on a future/stream
-        # that will never resolve — fail in-flight rows and the queue
+        # that will never resolve — fail in-flight rows, the loop's
+        # pending deque (safe now: its owner is dead), and the queue
         for i in range(self.slots):
             self._finish(i, error=err)
         self._fail_admission(err)
+        self._drain_pending(err)
         self._drain_queue(err)
 
     def _fail_admission(self, err: Exception) -> None:
@@ -692,6 +914,7 @@ class DecodeEngine:
         if adm.req["stream"] is not None:
             adm.req["stream"].put(None)
         if adm.req.get("rid"):
+            self._cancelled.discard(adm.req["rid"])
             self.recorder.async_end(
                 "request", adm.req["rid"], cat="req", error=True,
             )
@@ -705,13 +928,24 @@ class DecodeEngine:
                 break
             if req is _POISON:
                 continue
-            if req["stream"] is not None:
-                req["stream"].put(None)
-            if req.get("rid"):
-                self.recorder.async_end(
-                    "request", req["rid"], cat="req", error=True,
-                )
-            _fail_future(req["future"], err)
+            self._fail_queued(req, err)
+
+    def _drain_pending(self, err: Exception) -> None:
+        while self._pending:
+            self._fail_queued(self._pending.popleft(), err)
+
+    def _fail_queued(self, req: Dict[str, Any], err: Exception) -> None:
+        """Fail a request that never reached a slot: stream closed,
+        lifecycle span ended, future failed — shared by the close/break
+        drains and the deadline/cancel sweep."""
+        if req["stream"] is not None:
+            req["stream"].put(None)
+        if req.get("rid"):
+            self._cancelled.discard(req["rid"])
+            self.recorder.async_end(
+                "request", req["rid"], cat="req", error=True,
+            )
+        _fail_future(req["future"], err)
 
     # ----------------------------------------------------------- programs
 
@@ -899,6 +1133,25 @@ class DecodeEngine:
             # output to reuse (donating them just emits warnings)
             self._fns["insert"] = jax.jit(insert, donate_argnums=(0,))
         return self._fns["insert"]
+
+    def _deactivate_fn(self):
+        """Retire ONE row on device (deadline/cancel): the device
+        normally retires rows itself at EOS/budget, but a host-initiated
+        retirement must clear ``active`` (and zero the budget) or the
+        dead row keeps burning verify/scan lanes until its slot is
+        reused.  Composes onto the latest carry even with dispatches in
+        flight — JAX sequences it after them on the device stream."""
+        if "deactivate" not in self._fns:
+            jax, jnp = self._jax, self._jnp
+
+            def deact(dstate, slot):
+                out = dict(dstate)
+                out["active"] = dstate["active"].at[slot].set(False)
+                out["remaining"] = dstate["remaining"].at[slot].set(0)
+                return out
+
+            self._fns["deactivate"] = jax.jit(deact, donate_argnums=(0,))
+        return self._fns["deactivate"]
 
     def _dispatch_fn(self):
         """K single-token steps in one lax.scan — one host dispatch and
@@ -1149,35 +1402,51 @@ class DecodeEngine:
             # one tracing idiom: the lookup (and, on a hit, the host
             # assembly + upload — the stall active rows actually pay)
             # is a structured span on the engine track, its outcome in
-            # the span args (hit_tokens=0 is a recorded miss)
-            with self.recorder.span(
-                "prefix_cache.lookup", track="engine.loop",
-                prompt=len(ids), rid=rid,
-            ) as sp:
-                lease = self.prefix_cache.lookup(ids)
-                if lease is not None:
-                    try:
-                        adm.skip_capture = lease.tokens >= len(ids)
-                        p = min(lease.tokens, len(ids) - 1)
-                        cached_chunk = (start_pad + p) // c
-                        if cached_chunk > first_chunk:
-                            hit_tokens = cached_chunk * c - start_pad
-                            rows = self.prefix_cache.assemble(
-                                lease, cached_chunk * c, start_pad,
-                                hit_tokens,
-                            )
-                            adm.cache = self._prefill_init_cached_fn(
-                                cached_chunk * c
-                            )(
-                                jnp.int32(cached_chunk * c),
-                                *[jnp.asarray(r) for r in rows],
-                            )
-                            adm.next_chunk = cached_chunk
-                    finally:
-                        lease.release()
-                sp["hit_tokens"] = hit_tokens
-            if hit_tokens:
-                self.prefix_cache.record_hit(hit_tokens)
+            # the span args (hit_tokens=0 is a recorded miss).  A fault
+            # anywhere in the lookup/assemble/upload path is CONTAINED
+            # to a cache-bypass: the admission falls back to a cold
+            # prefill (degraded mode, counted) instead of failing the
+            # request — the cache is an accelerator, never a
+            # correctness dependency.
+            try:
+                with self.recorder.span(
+                    "prefix_cache.lookup", track="engine.loop",
+                    prompt=len(ids), rid=rid,
+                ) as sp:
+                    lease = self.prefix_cache.lookup(ids)
+                    if lease is not None:
+                        try:
+                            adm.skip_capture = lease.tokens >= len(ids)
+                            p = min(lease.tokens, len(ids) - 1)
+                            cached_chunk = (start_pad + p) // c
+                            if cached_chunk > first_chunk:
+                                hit_tokens = cached_chunk * c - start_pad
+                                rows = self.prefix_cache.assemble(
+                                    lease, cached_chunk * c, start_pad,
+                                    hit_tokens,
+                                )
+                                adm.cache = self._prefill_init_cached_fn(
+                                    cached_chunk * c
+                                )(
+                                    jnp.int32(cached_chunk * c),
+                                    *[jnp.asarray(r) for r in rows],
+                                )
+                                adm.next_chunk = cached_chunk
+                        finally:
+                            lease.release()
+                    sp["hit_tokens"] = hit_tokens
+                if hit_tokens:
+                    self.prefix_cache.record_hit(hit_tokens)
+            except Exception as e:
+                hit_tokens = 0
+                adm.cache = None  # cold fallback below rebuilds it
+                adm.next_chunk = first_chunk
+                adm.skip_capture = False
+                self._stats["cache_degraded"] += 1
+                self.recorder.instant(
+                    "cache_degraded", track="engine.loop", rid=rid,
+                    error=f"{type(e).__name__}: {e}",
+                )
         req["cache_hit_tokens"] = hit_tokens
         if adm.cache is None:
             adm.cache = self._prefill_init_fn()(jnp.int32(first_chunk * c))
@@ -1192,6 +1461,13 @@ class DecodeEngine:
         adm = self._adm
         c, s_bucket = adm.chunk, adm.s_bucket
         lo = adm.next_chunk * c
+        self._busy_since = time.perf_counter()
+        try:
+            return self._run_admission_chunk_inner(jnp, adm, c, s_bucket, lo)
+        finally:
+            self._busy_since = None
+
+    def _run_admission_chunk_inner(self, jnp, adm, c, s_bucket, lo):
         with self.recorder.span(
             "prefill_chunk", track="engine.loop",
             chunk=adm.next_chunk, of=adm.n_chunks,
@@ -1223,12 +1499,17 @@ class DecodeEngine:
             # off: adm.cache is an immutable device pytree the insert
             # below does not donate, and the worker's reference keeps
             # it alive.
-            self.prefix_cache.bind_layout(adm.cache)
-            self.prefix_cache.insert_async(
-                self._capture_fn(adm.capture_lo, s_bucket), adm.cache,
-                req["ids"], s_bucket - len(req["ids"]),
-                adm.capture_lo,
-            )
+            try:
+                self.prefix_cache.bind_layout(adm.cache)
+                self.prefix_cache.insert_async(
+                    self._capture_fn(adm.capture_lo, s_bucket), adm.cache,
+                    req["ids"], s_bucket - len(req["ids"]),
+                    adm.capture_lo,
+                )
+            except Exception:
+                # capture is best-effort: a fault here degrades the
+                # cache, never the request that just finished prefilling
+                self._stats["cache_degraded"] += 1
         slot = self._host.index(None)
         row_presence = np.zeros((1, self.vocab), bool)
         if req["repetition_penalty"] != 1.0:
@@ -1268,6 +1549,8 @@ class DecodeEngine:
         if sl is None:
             return
         req = sl.req
+        if req.get("rid"):
+            self._cancelled.discard(req["rid"])
         if req["stream"] is not None:
             req["stream"].put(None)
         if error is not None:
@@ -1310,7 +1593,9 @@ class DecodeEngine:
             result["cache_hit_tokens"] = int(req.get("cache_hit_tokens", 0))
         if req["logprobs"]:
             result["logprobs"] = [round(lp, 5) for _, lp in sl.emitted]
-        req["future"].set_result(result)
+        # idempotent: the watchdog may have failed this future during a
+        # stall the runtime later recovered from — its verdict stands
+        _set_result(req["future"], result)
 
     def _issue_dispatch(self) -> None:
         """Issue ONE dispatch and return WITHOUT blocking on its
@@ -1323,12 +1608,20 @@ class DecodeEngine:
         host's dispatch+unpack work for N runs while the device
         executes N+1."""
         seq = next(self._dispatch_seq)
-        with self.recorder.span(
-            "issue", track="engine.loop", seq=seq,
-        ):
-            self._dstate, packed = self._dispatch_fn()(
-                self.variables, self._dstate
-            )
+        self._busy_since = time.perf_counter()
+        try:
+            # chaos surface: raise = dispatch exception (the loop fails
+            # everything and dies cleanly), sleep = wedged runtime (the
+            # watchdog's stall clock is already running)
+            _inject_fault("engine.dispatch")
+            with self.recorder.span(
+                "issue", track="engine.loop", seq=seq,
+            ):
+                self._dstate, packed = self._dispatch_fn()(
+                    self.variables, self._dstate
+                )
+        finally:
+            self._busy_since = None
         self._inflight.append((packed, time.perf_counter(), seq))
         p = self._pstats
         p["issued"] += 1
@@ -1351,13 +1644,18 @@ class DecodeEngine:
         pipeline depth."""
         packed, t_issue, seq = self._inflight.popleft()
         t_block = time.perf_counter()
-        # the resolve span's duration IS the blocked wait; the time the
-        # pipeline hid (issue -> block) rides as an arg
-        with self.recorder.span(
-            "resolve", track="engine.loop", seq=seq,
-            hidden_ms=round((t_block - t_issue) * 1e3, 3),
-        ):
-            arr = np.asarray(packed)  # (3, K, slots) f32, one transfer
+        self._busy_since = t_block
+        try:
+            _inject_fault("engine.resolve")  # chaos: slow readback
+            # the resolve span's duration IS the blocked wait; the time
+            # the pipeline hid (issue -> block) rides as an arg
+            with self.recorder.span(
+                "resolve", track="engine.loop", seq=seq,
+                hidden_ms=round((t_block - t_issue) * 1e3, 3),
+            ):
+                arr = np.asarray(packed)  # (3, K, slots) f32, 1 transfer
+        finally:
+            self._busy_since = None
         t_done = time.perf_counter()
         p = self._pstats
         p["hidden_ms"] += (t_block - t_issue) * 1e3
@@ -1409,11 +1707,12 @@ class DecodeEngine:
             self._loop_body()
         finally:
             # LOOP-OWNED final drain: whatever path ended the loop —
-            # close(), a fatal error, or a wedged dispatch finally
-            # returning after an abandoned close() — nothing may be
-            # left waiting on a future this thread will never resolve.
-            # Idempotent vs close()'s own drain (_finish clears the
-            # slot, _fail_future tolerates the loser of the race).
+            # close(), a fatal error, a watchdog stall verdict, or a
+            # wedged dispatch finally returning after an abandoned
+            # close() — nothing may be left waiting on a future this
+            # thread will never resolve.  Idempotent vs close()'s own
+            # drain (_finish clears the slot, _fail_future tolerates
+            # the loser of the race).
             err = self._broken or RuntimeError("decode engine closed")
             # unread in-flight outputs are dropped, not resolved: their
             # rows' futures fail below, and blocking here on a possibly
@@ -1422,25 +1721,111 @@ class DecodeEngine:
             for i in range(self.slots):
                 self._finish(i, error=err)
             self._fail_admission(err)
+            self._drain_pending(err)
             self._drain_queue(err)
 
-    def _loop_body(self) -> None:
-        while not self._stop.is_set():
-            if self._broken is not None:
-                # donated buffers may be gone: fail queued requests fast
-                try:
-                    req = self._queue.get(timeout=0.2)
-                except queue.Empty:
-                    continue
-                if req is _POISON:
-                    continue
-                if req["stream"] is not None:
-                    req["stream"].put(None)
-                _fail_future(
-                    req["future"],
-                    RuntimeError(f"decode engine is down: {self._broken!r}"),
+    # ------------------------------------------------ boundary maintenance
+
+    def _pump_queue(self, block_s: float = 0.0) -> None:
+        """Move everything parked in the thread-safe submit queue into
+        the loop-owned ``_pending`` deque, where the deadline/cancel
+        sweep can retire QUEUED requests at a dispatch boundary instead
+        of only when a slot frees.  Blocks up to ``block_s`` for the
+        first item when the engine is idle."""
+        try:
+            item = (
+                self._queue.get(timeout=block_s) if block_s
+                else self._queue.get_nowait()
+            )
+            while True:
+                # skip poison pills and futures submit's close/broken
+                # race check already failed (their request must not be
+                # decoded by a restarted loop)
+                if item is not _POISON and not item["future"].done():
+                    self._pending.append(item)
+                item = self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def _retire_check(
+        self, req: Dict[str, Any], now: Optional[float] = None,
+    ) -> Optional[Exception]:
+        """The retirement verdict for one request: RequestCancelled /
+        DeadlineExceeded when due, else None."""
+        rid = req.get("rid")
+        if rid and rid in self._cancelled:
+            return RequestCancelled(f"request {rid} cancelled")
+        td = req.get("t_deadline")
+        if td is not None:
+            if now is None:
+                now = time.perf_counter()
+            if now >= td:
+                return DeadlineExceeded(
+                    f"request {rid or '?'} exceeded its deadline"
                 )
+        return None
+
+    def _count_retire(self, err: Exception, req: Dict[str, Any]) -> None:
+        rid = req.get("rid", 0)
+        if isinstance(err, RequestCancelled):
+            self._stats["cancelled"] += 1
+            self.recorder.instant("cancel", track="engine.loop", rid=rid)
+        else:
+            self._stats["deadline_exceeded"] += 1
+            self.recorder.instant("deadline", track="engine.loop", rid=rid)
+        self._cancelled.discard(rid)
+
+    def _boundary_maintenance(self, block_s: float = 0.0) -> None:
+        """Per-boundary housekeeping (loop thread): pump the submit
+        queue, then retire queued and active requests whose deadline
+        passed or whose rid was cancelled.  Queued requests fail in
+        place (no slot was ever taken); an active row is deactivated on
+        DEVICE (the engine's own retirement path only fires at EOS/
+        budget) and its slot freed for the next admission.  Fault-free
+        cost is one queue poll + an O(slots + pending) scan per
+        boundary — gated <1% of dispatch wall by bench.py's resilience
+        A/B."""
+        self._pump_queue(block_s)
+        if not self._pending and not self._cancelled and all(
+            s is None or s.req.get("t_deadline") is None
+            for s in self._host
+        ):
+            return
+        now = time.perf_counter()
+        if self._pending:
+            kept: Deque[Dict[str, Any]] = deque()
+            for req in self._pending:
+                err = self._retire_check(req, now)
+                if err is None:
+                    kept.append(req)
+                else:
+                    self._count_retire(err, req)
+                    self._fail_queued(req, err)
+            self._pending = kept
+        for i, sl in enumerate(self._host):
+            if sl is None:
                 continue
+            err = self._retire_check(sl.req, now)
+            if err is None:
+                continue
+            self._count_retire(err, sl.req)
+            # device first, then host: once _finish clears the slot a
+            # new admission may claim it, and the insert must not race
+            # a still-active old row
+            self._dstate = self._deactivate_fn()(
+                self._dstate, self._jnp.int32(i)
+            )
+            self._finish(i, error=err)
+
+    # -------------------------------------------------------- drive loop
+
+    def _loop_body(self) -> None:
+        while not (self._stop.is_set() or self._exit_loop.is_set()):
+            if self._broken is not None:
+                # engine-level failure (donated buffers may be gone):
+                # fail every waiter and EXIT — the watchdog sees a
+                # clean death and decides whether to restart
+                return
             try:
                 # one admission in flight at a time, one CHUNK of it per
                 # boundary: the joiner's prefill interleaves with decode
@@ -1450,45 +1835,47 @@ class DecodeEngine:
                 # starts, and admission iterations run synchronous
                 # (keep=0), so chunks and the insert always compose
                 # onto a fully-resolved carry.
-                if self._adm is None and None in self._host:
-                    idle = not self._inflight and all(
-                        s is None for s in self._host
-                    )
+                idle = (
+                    self._adm is None and not self._inflight
+                    and not self._pending
+                    and all(s is None for s in self._host)
+                )
+                self._boundary_maintenance(block_s=0.2 if idle else 0.0)
+                if (self._adm is None and None in self._host
+                        and self._pending):
+                    # JOIN boundary drain: resolve every pending
+                    # dispatch BEFORE the admission so it sees the
+                    # host's fresh slot view and a resolved carry —
+                    # exact FIFO slot order and the one-chunk stall
+                    # bound hold at any depth.  FINISH boundaries need
+                    # no drain: the device retires rows itself, so an
+                    # in-flight dispatch on a finished row emits
+                    # nothing — the host just learns one boundary
+                    # later.
+                    if self._inflight:
+                        with self.recorder.span(
+                            "join_drain", track="engine.loop",
+                            inflight=len(self._inflight),
+                        ):
+                            while self._inflight:
+                                self._process_oldest()
+                    req = self._pending.popleft()
                     try:
-                        req = self._queue.get(timeout=0.2 if idle else 0)
-                    except queue.Empty:
-                        req = None
-                    if req is _POISON:
-                        continue
-                    if req is not None:
-                        # JOIN boundary drain: resolve every pending
-                        # dispatch AFTER the dequeue (a pre-get
-                        # emptiness check would race submit()) so the
-                        # admission sees the host's fresh slot view and
-                        # a resolved carry — exact FIFO slot order and
-                        # the one-chunk stall bound hold at any depth.
-                        # FINISH boundaries need no drain: the device
-                        # retires rows itself, so an in-flight dispatch
-                        # on a finished row emits nothing — the host
-                        # just learns one boundary later.
-                        if self._inflight:
-                            with self.recorder.span(
-                                "join_drain", track="engine.loop",
-                                inflight=len(self._inflight),
-                            ):
-                                while self._inflight:
-                                    self._process_oldest()
-                        try:
-                            self._start_admission(req)
-                        except Exception as e:
-                            if req["stream"] is not None:
-                                req["stream"].put(None)
-                            _fail_future(req["future"], e)
-                if self._adm is not None:
-                    try:
-                        self._run_admission_chunk()
+                        self._start_admission(req)
                     except Exception as e:
-                        self._fail_admission(e)
+                        self._fail_queued(req, e)
+                if self._adm is not None:
+                    # a cancel/deadline landing mid-prefill retires the
+                    # admission between its chunks
+                    err = self._retire_check(self._adm.req)
+                    if err is not None:
+                        self._count_retire(err, self._adm.req)
+                        self._fail_admission(err)
+                    else:
+                        try:
+                            self._run_admission_chunk()
+                        except Exception as e:
+                            self._fail_admission(e)
                 issued = False
                 if any(s is not None for s in self._host):
                     self._issue_dispatch()
@@ -1503,12 +1890,147 @@ class DecodeEngine:
                 ) else 0
                 while len(self._inflight) > keep:
                     self._process_oldest()
-            except Exception as e:  # engine-level failure: fail active rows
+            except Exception as e:  # engine-level failure
                 self._broken = e
-                # drop unread in-flight outputs NOW: the broken branch
-                # never processes them, and until close() they'd pin
-                # device buffers and show phantom in-flight depth
+                if self._unhealthy_reason is None:
+                    self._unhealthy_reason = (
+                        f"drive loop error: {type(e).__name__}: {e}"
+                    )
+                # drop unread in-flight outputs NOW (they'd pin device
+                # buffers), fail everything via the finally drain, and
+                # die CLEANLY — stranding queued futures on a dead
+                # thread was this PR's headline bug, and a clean death
+                # is what lets the watchdog restart the loop
                 self._inflight.clear()
-                for i in range(self.slots):
-                    self._finish(i, error=e)
-                self._fail_admission(e)
+                return
+
+    # ----------------------------------------------------------- watchdog
+
+    def _watchdog_loop(self) -> None:
+        """Monitor thread: declares a stall when the drive loop sits in
+        one device call past ``dispatch_stall_timeout`` (fails the
+        waiters host-side with ``EngineStalled`` and asks the loop to
+        exit when it unsticks), and restarts a provably-DEAD loop —
+        once per incident, and only if the engine resolved at least one
+        dispatch since the previous restart (a crash loop stays down
+        instead of flapping)."""
+        stall_declared = False
+        while True:
+            # timeout re-read every tick: operators/tests may retune
+            # it on a live engine (generous during compile-heavy
+            # warmup, tight in steady state; None/0 = stall detection
+            # off for that tick — dead-loop restarts keep working)
+            timeout = self.dispatch_stall_timeout
+            wait_s = min(max((timeout or 1.0) / 4.0, 0.02), 1.0)
+            if self._stop.wait(wait_s):
+                return
+            try:
+                busy = self._busy_since
+                if (timeout and not stall_declared and busy is not None
+                        and time.perf_counter() - busy > timeout
+                        and self._thread.is_alive()):
+                    stall_declared = True
+                    self._fire_stall(time.perf_counter() - busy)
+                if not self._thread.is_alive() and not self._stop.is_set():
+                    if self._maybe_restart():
+                        stall_declared = False
+            except Exception as e:
+                # the watchdog is the backstop: it must survive its own
+                # races (e.g. a deque mutating mid-snapshot while the
+                # loop unsticks) — a dead watchdog would silently drop
+                # stall detection AND the bounded restart
+                warnings.warn(
+                    f"engine watchdog tick failed ({e!r}); retrying "
+                    "next tick",
+                )
+
+    def _fire_stall(self, stuck_s: float) -> None:
+        err = EngineStalled(
+            f"dispatch exceeded dispatch_stall_timeout="
+            f"{self.dispatch_stall_timeout}s (stuck {stuck_s:.1f}s)"
+        )
+        self._stats["watchdog_stalls"] += 1
+        self._unhealthy_reason = str(err)
+        self._broken = err      # submits fail fast from here on
+        self._exit_loop.set()   # the loop dies when the call returns
+        self.recorder.instant(
+            "watchdog_fire", track="engine.watchdog",
+            stuck_s=round(stuck_s, 3),
+        )
+        # fail the WAITERS now (futures and streams are thread-safe and
+        # idempotent) so no client blocks for the full wedge; slot and
+        # queue bookkeeping stays loop-owned and is reconciled by the
+        # dying loop's drain / the restart
+        for sl in list(self._host):
+            if sl is None:
+                continue
+            if sl.req["stream"] is not None:
+                sl.req["stream"].put(None)
+            _fail_future(sl.req["future"], err)
+        adm = self._adm
+        if adm is not None:
+            if adm.req["stream"] is not None:
+                adm.req["stream"].put(None)
+            _fail_future(adm.req["future"], err)
+        # _pending snapshot may race the unsticking loop's own drain
+        # (deque mutated mid-iteration) — retry; whoever wins, both
+        # sides fail futures idempotently with comparable errors
+        pending = []
+        for _ in range(3):
+            try:
+                pending = list(self._pending)
+                break
+            except RuntimeError:
+                continue
+        for req in pending:
+            if req["stream"] is not None:
+                req["stream"].put(None)
+            _fail_future(req["future"], err)
+        # requests still parked in the submit queue (enqueued during
+        # the wedge, never pumped): fail their futures IN PLACE — the
+        # items stay queued so the loop's own drain stays the single
+        # owner of queue removal, and _pump_queue skips done futures
+        # if the runtime ever unsticks
+        with self._queue.mutex:
+            parked = [r for r in self._queue.queue if isinstance(r, dict)]
+        for req in parked:
+            if req["stream"] is not None:
+                req["stream"].put(None)
+            _fail_future(req["future"], err)
+
+    def _maybe_restart(self) -> bool:
+        """One bounded restart of a dead drive loop: rebuild the device
+        carry from scratch (the old pytree may have died mid-donation)
+        and start a fresh thread.  Refuses when closing/abandoned, or
+        when the loop died again without resolving a single dispatch
+        since the last restart."""
+        if self._abandoned or self._stop.is_set():
+            return False
+        d = self._stats["dispatches"]
+        if (self._dispatches_at_restart is not None
+                and d <= self._dispatches_at_restart):
+            self._unhealthy_reason = (
+                "drive loop died again with no progress since the last "
+                "watchdog restart; staying down"
+            )
+            return False
+        self._dispatches_at_restart = d
+        # the dead loop's finally-drain already failed every waiter;
+        # re-run the teardown idempotently in case it died inside it
+        err = self._broken or EngineStalled("drive loop died")
+        self._inflight.clear()
+        for i in range(self.slots):
+            self._finish(i, error=err)
+        self._fail_admission(err)
+        self._drain_pending(err)
+        self._host = [None] * self.slots
+        self._busy_since = None
+        self._dstate = self._fresh_dstate()
+        self._stats["watchdog_restarts"] += 1
+        self.recorder.instant("watchdog_restart", track="engine.watchdog")
+        self._exit_loop.clear()
+        self._broken = None
+        self._unhealthy_reason = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return True

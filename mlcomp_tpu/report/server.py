@@ -573,8 +573,16 @@ class _Handler(BaseHTTPRequestHandler):
 
         def fetch(path):
             req = urllib.request.Request(base + path, headers=headers)
-            with urllib.request.urlopen(req, timeout=2) as r:
-                return json.loads(r.read())
+            try:
+                with urllib.request.urlopen(req, timeout=2) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    # unhealthy-but-alive (the watchdog flipped
+                    # /healthz): the body still carries full stats —
+                    # reachable, with healthy=false in the payload
+                    return json.loads(e.read())
+                raise
 
         out: dict = {"configured": True, "url": base}
         try:
@@ -700,6 +708,26 @@ class _Handler(BaseHTTPRequestHandler):
             gau("mlcomp_serving_pipeline_occupancy",
                 "Mean in-flight dispatch depth at issue",
                 pl.get("occupancy"))
+            # resilience state: health verdict, watchdog activity and
+            # admission-control rejects, lifted from the same /healthz
+            # payload so one scrape target alerts on a sick daemon
+            gau("mlcomp_serving_engine_healthy",
+                "1 while the daemon reports itself healthy (503 = 0)",
+                1 if health.get("healthy", True) else 0)
+            wd = eng.get("watchdog") or {}
+            ctr("mlcomp_serving_watchdog_stalls_total",
+                "Watchdog stall detections at the daemon",
+                wd.get("stalls"))
+            ctr("mlcomp_serving_watchdog_restarts_total",
+                "Watchdog drive-loop restarts at the daemon",
+                wd.get("restarts"))
+            rej_c = reg.counter(
+                "mlcomp_serving_requests_rejected_total",
+                "Requests the daemon's admission control fast-failed",
+                labelnames=("reason",),
+            )
+            for reason, n in sorted(health.get("rejected", {}).items()):
+                rej_c.set_total(float(n), reason=reason)
             pc = serving.get("prefix_cache") or {}
             ctr("mlcomp_serving_prefix_cache_hits_total",
                 "Prefix-cache lookup hits", pc.get("hits"))

@@ -46,9 +46,20 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
         sampling settings; with ``--prefix-cache`` responses carry
         ``cache_hit_tokens``, the prompt tokens whose prefill the
         host-RAM prefix KV cache skipped)
+        (an optional ``"deadline_s"`` bounds the request end to end,
+        clamped to ``--request-timeout`` — past it the engine retires
+        it at the next dispatch boundary and the response is 504
+        ``deadline_exceeded``; when admission
+        control is configured (``--max-queue-depth`` /
+        ``--max-concurrent-requests``) overload fast-fails with 429 +
+        ``Retry-After`` derived from live per-token latency — see
+        docs/serving.md "Failure semantics")
     GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...,
                         "latency": {p50/p95/p99 ttft + per-token ms},
                         "engine": {..., "pipeline": overlap metrics}}
+        (503 with ``"ok": false`` while the engine watchdog reports
+        the drive loop stalled/crashed; recovers after its bounded
+        restart)
     GET  /cache/stats -> prefix-cache hit/miss/eviction/byte counters
         (404 unless the service was built with ``prefix_cache=True``)
     GET  /metrics   -> Prometheus text exposition (mlcomp_tpu/obs):
@@ -72,11 +83,23 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from mlcomp_tpu.engine import _fail_future
+from mlcomp_tpu.engine import DeadlineExceeded, _fail_future
+
+
+class BackpressureError(RuntimeError):
+    """Admission control rejected the request (bounded queue or
+    concurrency cap): fast-fail with a drain estimate instead of
+    unbounded queueing.  HTTP maps this to 429 + ``Retry-After``."""
+
+    def __init__(self, msg: str, reason: str, retry_after_s: float):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
 
 
 def _bucket(value: int, buckets: Sequence[int], what: str) -> int:
@@ -146,6 +169,10 @@ class GenerationService:
         prefix_cache_bytes: int = 1 << 31,
         engine_pipeline_depth: Optional[int] = None,
         flight_recorder_events: Optional[int] = 32768,
+        request_timeout_s: float = 600.0,
+        max_queue_depth: int = 0,
+        max_concurrent_requests: int = 0,
+        dispatch_stall_timeout: Optional[float] = None,
     ):
         import jax
 
@@ -238,6 +265,19 @@ class GenerationService:
         self._queue: "queue.Queue" = queue.Queue()
         self._deferred: List[Dict[str, Any]] = []  # batcher thread only
         self._stats = {"requests": 0, "batches": 0, "batched_rows": 0}
+        # resilience knobs: every request gets a deadline (default: the
+        # request timeout — the old hardcoded 600 s futures, made
+        # configurable and engine-enforced), and admission control
+        # fast-fails past the bounded queue/concurrency caps (0 =
+        # unbounded, the historical behavior)
+        self.request_timeout_s = float(request_timeout_s)
+        if self.request_timeout_s <= 0:
+            raise ValueError(
+                f"request_timeout_s must be positive, got {request_timeout_s}"
+            )
+        self.max_queue_depth = int(max_queue_depth or 0)
+        self.max_concurrent_requests = int(max_concurrent_requests or 0)
+        self._rejects = {"queue_full": 0, "concurrency": 0}
         # the scrape registry behind GET /metrics: the engine (and its
         # prefix cache) register collectors into it below; the service
         # contributes its own batcher counters — one exposition per
@@ -362,6 +402,7 @@ class GenerationService:
                 pipeline_depth=engine_pipeline_depth,
                 flight_recorder_events=flight_recorder_events,
                 metrics=self.metrics,
+                dispatch_stall_timeout=dispatch_stall_timeout,
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous
@@ -387,6 +428,7 @@ class GenerationService:
         logprobs: bool = False,
         repetition_penalty: Optional[float] = None,
         stream: Optional["queue.Queue"] = None,
+        deadline_s: Optional[float] = None,
     ) -> Future:
         """Enqueue one generation request; resolves to a list of the
         GENERATED ids (prompt excluded, truncated at the request's
@@ -399,7 +441,16 @@ class GenerationService:
         ``stream`` (continuous batcher only): a ``queue.Queue`` that
         receives ``{"token", "logprob", "step"}`` dicts as each token
         lands, then ``None`` — the transport behind the HTTP SSE
-        endpoint."""
+        endpoint.
+
+        ``deadline_s`` (continuous batcher only; default — and upper
+        clamp — is the service's ``request_timeout_s``) bounds the
+        request end to end — past it
+        the engine retires the request at the next dispatch boundary
+        and the future fails with ``DeadlineExceeded`` (HTTP: 504).
+        Admission control may reject BEFORE queueing with
+        ``BackpressureError`` (HTTP: 429 + ``Retry-After``) when the
+        bounded queue or concurrency cap is hit."""
         ids = [int(t) for t in prompt_ids]
         if not ids:
             raise ValueError("prompt must be non-empty")
@@ -473,17 +524,30 @@ class GenerationService:
                     "batcher"
                 )
         if self.engine is not None:
+            self._admission_check()
+            # per-request deadlines may only TIGHTEN the operator's
+            # --request-timeout budget: a slot is a shared resource,
+            # so a client cannot extend its hold past the service cap
+            eff_deadline = self.request_timeout_s
+            if deadline_s is not None:
+                eff_deadline = min(float(deadline_s), eff_deadline)
             # the engine counts its own requests (stats() surfaces that
             # count as the service total) — incrementing here too would
             # double-count every continuous-mode request
             return self.engine.submit(
                 ids, n_new, temperature=t, top_k=k, top_p=p, eos_id=eos,
                 logprobs=logprobs, repetition_penalty=rp, stream=stream,
+                deadline_s=eff_deadline,
             )
         if stream is not None:
             raise ValueError(
                 "token streaming needs the continuous batcher; this "
                 f"service runs the {self.batcher} batcher"
+            )
+        if deadline_s is not None:
+            raise ValueError(
+                "per-request deadlines need the continuous batcher; "
+                f"this service runs the {self.batcher} batcher"
             )
         self._stats["requests"] += 1
         fut: Future = Future()
@@ -500,6 +564,74 @@ class GenerationService:
 
     def generate(self, prompt_ids, max_new_tokens, **knobs):
         return self.submit(prompt_ids, max_new_tokens, **knobs).result()
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a live continuous-engine request by rid (the ``rid``
+        attribute of a submitted Future) — the HTTP layer calls this
+        when a streaming client disconnects.  Returns False for
+        batchers without a cancellation path."""
+        if self.engine is None:
+            return False
+        return self.engine.cancel(rid)
+
+    def _retry_after_s(self) -> float:
+        """Drain estimate behind 429's ``Retry-After``: how long until
+        roughly one queue's worth of work clears, from the live
+        per-token latency — (waiting + active) requests × the mean
+        tokens each emits × p50 per-token ms, spread over the slot
+        pool.  Falls back to 1 s before any latency samples exist;
+        clamped to [1, 60] so a pathological estimate never tells
+        clients to go away for an hour."""
+        eng = self.engine
+        try:
+            samples = list(eng._lat_tok)
+        except RuntimeError:
+            # the loop thread appended mid-iteration; a reject under
+            # exactly that load still needs SOME answer, not a 500
+            samples = []
+        if not samples:
+            return 1.0
+        per_tok = float(np.median(np.asarray(samples)))
+        st = eng._stats
+        finished = max(1, eng._lat_ttft_n)
+        mean_tokens = max(1.0, st["emitted_tokens"] / finished)
+        waiting = eng._queue.qsize() + len(eng._pending) + 1
+        active = sum(1 for s in eng._host if s is not None)
+        eta = (waiting + active) * mean_tokens * per_tok / (
+            eng.slots * 1e3
+        )
+        return float(min(max(eta, 1.0), 60.0))
+
+    def _admission_check(self) -> None:
+        """Bounded-queue / concurrency fast-fail (continuous engine).
+        Approximate by design — two racing submits may both pass a
+        cap-1 check — which is the standard admission-control trade:
+        the bound is 'about N', never a hung client."""
+        eng = self.engine
+        if self.max_queue_depth <= 0 and self.max_concurrent_requests <= 0:
+            return
+        depth = eng._queue.qsize() + len(eng._pending)
+        reason = None
+        if 0 < self.max_queue_depth <= depth:
+            reason = "queue_full"
+            msg = (
+                f"submit queue is full ({depth} >= max_queue_depth="
+                f"{self.max_queue_depth})"
+            )
+        else:
+            active = sum(1 for s in eng._host if s is not None)
+            inflight = depth + active + (1 if eng._adm is not None else 0)
+            if 0 < self.max_concurrent_requests <= inflight:
+                reason = "concurrency"
+                msg = (
+                    f"{inflight} requests in flight >= "
+                    f"max_concurrent_requests={self.max_concurrent_requests}"
+                )
+        if reason is None:
+            return
+        self._rejects[reason] += 1
+        eng.recorder.instant("reject", track="service", reason=reason)
+        raise BackpressureError(msg, reason, self._retry_after_s())
 
     def warmup(self) -> int:
         """Precompile the hot programs by RUNNING a dummy generation per
@@ -519,7 +651,9 @@ class GenerationService:
                 for s in self.prompt_buckets
             ]
             for f in futs:
-                f.result(timeout=600)
+                # the configurable request timeout, not a magic 600:
+                # warmup compiles, so the cap matters on slow backends
+                f.result(timeout=self.request_timeout_s)
             # prefix-cache capture/insert programs (cheap: no model
             # trace) — without this the first real request pays their
             # compile on the engine loop thread mid-serving
@@ -579,6 +713,11 @@ class GenerationService:
             "compiled": sorted(self._fns),
             "quantize": self.quant_mode,
             "batcher": self.batcher,
+            # window/speculative batchers have no watchdog: a live
+            # batcher thread is the whole health story
+            "healthy": True,
+            "rejected": dict(self._rejects),
+            "request_timeout_s": self.request_timeout_s,
         }
         if self.engine is not None:
             # the engine is the single counter of continuous-mode
@@ -587,6 +726,9 @@ class GenerationService:
             eng = self.engine.stats()
             out["queue_depth"] = eng.pop("queue_depth")
             out["requests"] = eng["requests"]
+            # the engine's watchdog verdict IS the daemon's health
+            # (behind /healthz's 200-vs-503)
+            out["healthy"] = eng.get("healthy", True)
             # request-latency percentiles (p50/p95/p99 TTFT and
             # per-token) ride at the TOP level too: the /healthz
             # payload and the report server's /api/serving proxy read
@@ -614,6 +756,13 @@ class GenerationService:
             "Service configuration (value is always 1)",
             labelnames=("batcher", "quantize"),
         ).set(1, batcher=self.batcher, quantize=str(self.quant_mode))
+        rej = m.counter(
+            "mlcomp_serving_requests_rejected_total",
+            "Requests fast-failed by admission control",
+            labelnames=("reason",),
+        )
+        for reason, n in self._rejects.items():
+            rej.set_total(n, reason=reason)
         m.counter(
             "mlcomp_service_batches_total",
             "Batches run (window/speculative batchers)",
@@ -1062,8 +1211,14 @@ def make_http_server(
                 return self._json({"error": "invalid or missing token"}, 403)
             route, _, query = self.path.partition("?")
             if route == "/healthz":
+                st = service.stats()
+                ok = bool(st.get("healthy", True))
+                # 503 while the engine is stalled/broken (load
+                # balancers pull the backend); the body still carries
+                # the full stats so operators see WHY
                 return self._json(
-                    {"ok": True, "model": model_name, **service.stats()}
+                    {"ok": ok, "model": model_name, **st},
+                    200 if ok else 503,
                 )
             if route == "/metrics":
                 from mlcomp_tpu.obs.metrics import CONTENT_TYPE
@@ -1116,7 +1271,14 @@ def make_http_server(
             Never raises: once the 200/event-stream headers are out, a
             failure must terminate the STREAM (an ``error`` event), not
             fall back to do_POST's JSON error path — that would write a
-            second status line into the open body."""
+            second status line into the open body.  A broken pipe is
+            client-disconnect detection: the request is CANCELLED at
+            the engine so the row frees its slot at the next dispatch
+            boundary instead of decoding for nobody."""
+            # grace past the request timeout (every deadline clamps to
+            # it): the engine fails the future at the deadline first,
+            # so hitting THIS wait means the engine is unresponsive
+            timeout = service.request_timeout_s + 30.0
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -1124,22 +1286,29 @@ def make_http_server(
             self.end_headers()
             try:
                 while True:
-                    item = toks.get(timeout=600)
+                    item = toks.get(timeout=timeout)
                     if item is None:
                         break
                     self.wfile.write(
                         f"data: {json.dumps(item)}\n\n".encode()
                     )
                     self.wfile.flush()
-                final = fut.result(timeout=600)
+                final = fut.result(timeout=timeout)
                 self.wfile.write(
                     f"data: {json.dumps({'done': True, **final})}\n\n".encode()
                 )
                 self.wfile.flush()
-            except BrokenPipeError:
-                pass  # client went away; the engine row finishes on its own
+            except ConnectionError:
+                # client went away (broken pipe OR reset — curl Ctrl-C
+                # and proxy teardown surface as RST): retire the row,
+                # don't decode on
+                service.cancel(getattr(fut, "rid", 0))
             except Exception as e:
-                err = json.dumps({"error": f"{type(e).__name__}: {e}"})
+                status = getattr(e, "status", None)
+                err = json.dumps({
+                    "error": f"{type(e).__name__}: {e}",
+                    **({"status": status} if status else {}),
+                })
                 try:
                     self.wfile.write(f"data: {err}\n\n".encode())
                     self.wfile.flush()
@@ -1166,14 +1335,46 @@ def make_http_server(
                     logprobs=req.get("logprobs", False),
                     repetition_penalty=req.get("repetition_penalty"),
                     stream=toks,
+                    deadline_s=req.get("deadline_s"),
                 )
                 if want_stream:
                     return self._stream(fut, toks)
-                return self._json(fut.result(timeout=600))
+                # grace past the engine-enforced deadline (deadlines
+                # clamp to the request timeout): the engine retires
+                # the request and fails the future first, so this wait
+                # resolving by TimeoutError means the engine itself is
+                # unresponsive — also a gateway timeout
+                return self._json(
+                    fut.result(timeout=service.request_timeout_s + 30.0)
+                )
+            except BackpressureError as e:
+                body = json.dumps({
+                    "error": str(e), "status": "rejected",
+                    "reason": e.reason,
+                    "retry_after_s": round(e.retry_after_s, 1),
+                }).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header(
+                    "Retry-After", str(max(1, int(round(e.retry_after_s))))
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
+            except (DeadlineExceeded, FutTimeout) as e:
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "status": "deadline_exceeded"}, 504,
+                )
             except (KeyError, ValueError, TypeError) as e:
                 return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
             except Exception as e:
-                return self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                status = getattr(e, "status", None)
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     **({"status": status} if status else {})}, 500,
+                )
 
     return ThreadingHTTPServer((host, port), Handler)
 

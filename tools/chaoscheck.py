@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Chaos harness for the serving resilience layer: a live toy daemon is
+driven through each serving fault point (utils/faults.py) and must come
+back healthy with nothing leaked.
+
+Scenarios, against the real serve daemon HTTP stack
+(``serve.make_http_server``, continuous engine, prefix cache on,
+watchdog armed):
+
+- **slow resolve** (``engine.resolve`` sleep): latency rises, nothing
+  breaks — tokens stay bit-identical;
+- **dispatch exception** (``engine.dispatch`` raise): the drive loop
+  fails every waiter with the error and dies CLEANLY; the watchdog
+  restarts it and the replayed baseline traffic is bit-identical;
+- **dispatch stall** (``engine.dispatch`` sleep past
+  ``dispatch_stall_timeout``): the watchdog fails the in-flight
+  request in bounded time (far before its deadline), ``/healthz``
+  serves 503 while wedged, and once the runtime unsticks the loop dies
+  and is restarted — replay bit-identical;
+- **cache lookup raise** (``cache.lookup``): contained to a
+  degraded-mode cache BYPASS — the request still succeeds with exact
+  tokens, ``cache_hit_tokens`` 0, ``cache_degraded`` counted;
+- **cache capture raise** (``cache.capture``): contained to
+  ``insert_errors`` on the capture worker; serving continues.
+
+Recovery invariants asserted after EVERY scenario:
+
+- no future hangs: every HTTP call returns (success or a typed error)
+  well inside its deadline;
+- no slot leaks: ``active_slots`` and ``queue_depth`` drain to 0;
+- no pin leaks: the prefix index reports 0 ``outstanding_leases`` and
+  0 ``pinned_nodes`` (capture queue flushed);
+- health recovers: ``/healthz`` is 200/ok again, and surviving
+  requests' token streams are bit-identical to the fault-free run.
+
+No TPU needed (CPU jax), finishes in seconds; tests/test_chaoscheck.py
+wires it into tier-1 like cachecheck/obs_check.  Standalone:
+
+    python tools/chaoscheck.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mlcomp_tpu.utils import faults  # noqa: E402
+
+
+class _Daemon:
+    """The toy serving daemon + typed HTTP helpers."""
+
+    def __init__(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+
+        from mlcomp_tpu.models import create_model
+        from mlcomp_tpu.serve import GenerationService, make_http_server
+        from mlcomp_tpu.train.state import init_model
+
+        model = create_model({
+            "name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+            "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32",
+        })
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(1, 64, (1, 8))
+        )
+        params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+        # generous stall timeout at construction (the first dispatches
+        # COMPILE, and compile time is busy time to the watchdog); the
+        # stall scenario tightens it once the programs are warm
+        self.svc = GenerationService(
+            model, {"params": params}, batch_sizes=(1, 2),
+            prompt_buckets=(16,), max_new_buckets=(8,),
+            prefix_cache=True, prefill_chunk=8,
+            dispatch_stall_timeout=60.0,
+        )
+        self.httpd = make_http_server(self.svc, "127.0.0.1", 0, "chaos")
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        self.base = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def generate(self, ids, deadline_s=None, timeout=120):
+        """POST /generate -> (http_code, payload dict).  Never raises
+        on HTTP error codes — the codes ARE the contract under test."""
+        body = {"prompt": list(ids), "max_new_tokens": 4}
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
+        req = urllib.request.Request(
+            f"{self.base}/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def healthz(self):
+        try:
+            with urllib.request.urlopen(
+                f"{self.base}/healthz", timeout=10
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def wait_healthy(self, deadline_s=15.0) -> float:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline_s:
+            code, h = self.healthz()
+            if code == 200 and h.get("ok"):
+                return time.perf_counter() - t0
+            time.sleep(0.05)
+        raise AssertionError(
+            f"daemon did not recover within {deadline_s}s: {self.healthz()}"
+        )
+
+    def assert_drained(self, what: str) -> None:
+        """No leaked slots/queue entries/pins after a scenario."""
+        self.svc.prefix_cache.flush()
+        eng = self.svc.engine
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 10:
+            st = eng.stats()
+            if st["active_slots"] == 0 and st["queue_depth"] == 0:
+                break
+            time.sleep(0.05)
+        st = eng.stats()
+        assert st["active_slots"] == 0, (what, st)
+        assert st["queue_depth"] == 0, (what, st)
+        cs = self.svc.prefix_cache.stats()
+        assert cs["outstanding_leases"] == 0, (what, cs)
+        assert cs["pinned_nodes"] == 0, (what, cs)
+        assert cs["capture_queue_depth"] == 0, (what, cs)
+        self.svc.prefix_cache.index.check_invariants()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.svc.close()
+
+
+def run() -> dict:
+    d = _Daemon()
+    out = {}
+    prompts = [
+        [9, 10, 11, 12, 13, 14, 15, 16, 17, p] for p in (1, 2, 3)
+    ]
+
+    def drive_baseline(tag):
+        got = []
+        for p in prompts:
+            code, payload = d.generate(p)
+            assert code == 200, (tag, code, payload)
+            got.append(payload["ids"])
+        d.svc.prefix_cache.flush()
+        return got
+
+    try:
+        baseline = drive_baseline("warmup")
+        # replay once: surviving traffic must be deterministic before
+        # any fault makes "bit-identical after recovery" meaningful
+        assert drive_baseline("replay") == baseline
+        d.assert_drained("baseline")
+
+        # ---- scenario 0: slow resolve — degraded latency, exact tokens
+        faults.arm("engine.resolve", flavor="sleep", times=4, seconds=0.05)
+        assert drive_baseline("slow_resolve") == baseline
+        d.assert_drained("slow_resolve")
+        out["slow_resolve"] = "exact"
+
+        # ---- scenario 1: dispatch exception -> clean death -> restart
+        restarts0 = d.svc.engine.stats()["watchdog_restarts"]
+        faults.arm("engine.dispatch", flavor="raise", times=1)
+        t0 = time.perf_counter()
+        code, payload = d.generate(prompts[0], deadline_s=30)
+        elapsed = time.perf_counter() - t0
+        assert code == 500 and "FaultInjected" in payload["error"], (
+            code, payload,
+        )
+        assert elapsed < 20, f"future hung {elapsed:.1f}s past the fault"
+        d.wait_healthy()
+        assert d.svc.engine.stats()["watchdog_restarts"] == restarts0 + 1
+        assert drive_baseline("after_dispatch_exception") == baseline
+        d.assert_drained("dispatch_exception")
+        out["dispatch_exception"] = {
+            "failed_in_s": round(elapsed, 2), "recovered": True,
+        }
+
+        # ---- scenario 2: wedged dispatch -> watchdog -> 503 -> restart
+        eng = d.svc.engine
+        eng.dispatch_stall_timeout = 0.8  # programs are warm now
+        faults.arm("engine.dispatch", flavor="sleep", times=1, seconds=2.5)
+        saw_503 = []
+
+        def poll_health():
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 4 and not saw_503:
+                code, _ = d.healthz()
+                if code == 503:
+                    saw_503.append(time.perf_counter() - t0)
+                time.sleep(0.05)
+
+        poller = threading.Thread(target=poll_health)
+        poller.start()
+        t0 = time.perf_counter()
+        code, payload = d.generate(prompts[0], deadline_s=30)
+        elapsed = time.perf_counter() - t0
+        poller.join()
+        assert code == 500 and payload.get("status") == "engine_stalled", (
+            code, payload,
+        )
+        # the watchdog must beat both the 2.5 s wedge and the deadline
+        assert elapsed < 2.4, (
+            f"stalled future took {elapsed:.2f}s — the watchdog did not "
+            "fail it ahead of the wedge"
+        )
+        assert saw_503, "/healthz never served 503 during the wedge"
+        recovery_s = d.wait_healthy()
+        eng.dispatch_stall_timeout = 60.0
+        assert eng.stats()["watchdog_restarts"] == restarts0 + 2
+        assert drive_baseline("after_stall") == baseline
+        d.assert_drained("dispatch_stall")
+        out["dispatch_stall"] = {
+            "failed_in_s": round(elapsed, 2),
+            "recovered_in_s": round(recovery_s, 2),
+            "saw_503": True,
+        }
+
+        # ---- scenario 3: cache lookup raise -> degraded bypass
+        deg0 = d.svc.engine.stats()["cache_degraded"]
+        faults.arm("cache.lookup", flavor="raise", times=1)
+        code, payload = d.generate(prompts[0])
+        assert code == 200 and payload["ids"] == baseline[0], (code, payload)
+        assert payload["cache_hit_tokens"] == 0, payload
+        assert d.svc.engine.stats()["cache_degraded"] == deg0 + 1
+        # and the NEXT identical request hits the cache again
+        code, payload = d.generate(prompts[0])
+        assert code == 200 and payload["ids"] == baseline[0]
+        assert payload["cache_hit_tokens"] > 0, payload
+        d.assert_drained("cache_lookup")
+        out["cache_lookup_raise"] = "bypassed_exact"
+
+        # ---- scenario 4: cache capture raise -> insert_errors, alive
+        err0 = d.svc.prefix_cache.stats()["insert_errors"]
+        faults.arm("cache.capture", flavor="raise", times=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            code, payload = d.generate(
+                [40, 41, 42, 43, 44, 45, 46, 47, 48, 49]
+            )
+            assert code == 200 and len(payload["ids"]) == 4, (code, payload)
+            d.svc.prefix_cache.flush()
+        assert d.svc.prefix_cache.stats()["insert_errors"] == err0 + 1
+        assert drive_baseline("after_capture_fault") == baseline
+        d.assert_drained("cache_capture")
+        out["cache_capture_raise"] = "contained"
+
+        code, h = d.healthz()
+        assert code == 200 and h["ok"], (code, h)
+        out["final_health"] = {
+            "watchdog": h["engine"]["watchdog"],
+            "cache_degraded": h["engine"]["cache_degraded"],
+        }
+        return out
+    finally:
+        faults.disarm_all()
+        d.close()
+
+
+def main(argv=None) -> int:
+    out = run()
+    print(f"ok: {json.dumps(out)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,13 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_engine_trace_events_dropped_total",
     "mlcomp_engine_ttft_ms",
     "mlcomp_engine_per_token_ms",
+    "mlcomp_engine_healthy",
+    "mlcomp_engine_deadline_exceeded_total",
+    "mlcomp_engine_cancelled_total",
+    "mlcomp_engine_watchdog_stalls_total",
+    "mlcomp_engine_watchdog_restarts_total",
+    "mlcomp_cache_degraded_total",
+    "mlcomp_serving_requests_rejected_total",
     "mlcomp_service_info",
     "mlcomp_service_batches_total",
     "mlcomp_service_batched_rows_total",
@@ -71,6 +78,7 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_prefix_cache_evictions_total",
     "mlcomp_prefix_cache_bytes",
     "mlcomp_prefix_cache_nodes",
+    "mlcomp_prefix_cache_outstanding_leases",
     "mlcomp_prefix_cache_capture_queue_depth",
 ]
 
