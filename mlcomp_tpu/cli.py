@@ -357,6 +357,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         steps_per_dispatch=args.steps_per_dispatch,
         prefill_chunk=args.prefill_chunk,
         engine_pipeline_depth=args.engine_pipeline_depth,
+        engine_fused_admission=(
+            False if args.engine_staged_admission else None
+        ),
         spec_k=args.spec_k,
         engine_spec_k=args.engine_spec_k,
         prefix_cache=args.prefix_cache,
@@ -611,11 +614,20 @@ def main(argv=None) -> int:
         " carry before dispatch N's tokens are read back, so the"
         " host's per-dispatch overhead hides behind device compute."
         " 1 = the old synchronous loop (the debug/bisect mode:"
-        " outputs are bit-identical, only slower).  Joins and"
-        " admissions drain the pipeline for their boundary, so the"
-        " one-chunk admission stall bound holds at any depth."
-        " Single-chip for now: an explicit depth > 1 with --mesh is"
-        " rejected rather than silently degrading",
+        " outputs are bit-identical, only slower).  Admissions ride"
+        " the in-flight dispatches (fused prefill+decode); only the"
+        " final insert drains the pipeline, so joins cost one insert"
+        " at any depth.  Single-chip for now: an explicit depth > 1"
+        " with --mesh is rejected rather than silently degrading",
+    )
+    sv.add_argument(
+        "--engine-staged-admission", action="store_true",
+        help="continuous batcher: force the STAGED admission path —"
+        " every prefill chunk runs as its own dispatch at a drained"
+        " pipeline boundary (the pre-fused behavior; bisect/debug"
+        " mode, outputs bit-identical).  Default: a pending"
+        " admission's chunk rides the decode dispatch as one fused"
+        " program, so decode never pauses for a prefill",
     )
     sv.add_argument(
         "--prefix-cache", action="store_true",
@@ -633,8 +645,9 @@ def main(argv=None) -> int:
     sv.add_argument(
         "--prefill-chunk", type=int, default=256,
         help="continuous batcher: admission prefill chunk (tokens) —"
-        " active rows stall at most one chunk per boundary while a"
-        " joiner prefills; all-pad chunks are skipped",
+        " a joiner prefills one chunk per dispatch boundary (fused"
+        " into the decode dispatch by default); all-pad chunks are"
+        " skipped",
     )
     sv.add_argument(
         "--kv-quant", action="store_true",

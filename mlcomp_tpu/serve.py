@@ -168,6 +168,7 @@ class GenerationService:
         prefix_cache: bool = False,
         prefix_cache_bytes: int = 1 << 31,
         engine_pipeline_depth: Optional[int] = None,
+        engine_fused_admission: Optional[bool] = None,
         flight_recorder_events: Optional[int] = 32768,
         request_timeout_s: float = 600.0,
         max_queue_depth: int = 0,
@@ -363,6 +364,13 @@ class GenerationService:
             raise ValueError(
                 "engine_pipeline_depth > 1 needs the continuous batcher"
             )
+        if engine_fused_admission is not None and batcher != "continuous":
+            # only the continuous engine has admissions to fuse or
+            # stage; fail at construction rather than silently ignoring
+            # the bisect knob
+            raise ValueError(
+                "engine_fused_admission needs the continuous batcher"
+            )
         self.prefix_cache = None
         if prefix_cache:
             # host-RAM prefix KV cache (mlcomp_tpu/cache): only the
@@ -400,6 +408,7 @@ class GenerationService:
                 spec_k=engine_spec_k,
                 prefix_cache=self.prefix_cache,
                 pipeline_depth=engine_pipeline_depth,
+                fused_admission=engine_fused_admission,
                 flight_recorder_events=flight_recorder_events,
                 metrics=self.metrics,
                 dispatch_stall_timeout=dispatch_stall_timeout,
@@ -655,9 +664,12 @@ class GenerationService:
                 # warmup compiles, so the cap matters on slow backends
                 f.result(timeout=self.request_timeout_s)
             # prefix-cache capture/insert programs (cheap: no model
-            # trace) — without this the first real request pays their
+            # trace) and the fused prefill+decode dispatches (real
+            # compiles — one per chunk width) — without this the first
+            # real request / first overlapped admission pays their
             # compile on the engine loop thread mid-serving
-            return len(futs) + self.engine.warm_prefix_fns()
+            return (len(futs) + self.engine.warm_prefix_fns()
+                    + self.engine.warm_fused_fns())
         if self.batcher == "speculative":
             import jax.numpy as jnp
 
@@ -734,6 +746,12 @@ class GenerationService:
             # payload and the report server's /api/serving proxy read
             # them without digging through the engine section
             out["latency"] = eng.get("latency")
+            if "spec" in eng:
+                # the spec-honesty block rides at the top level too:
+                # operators watching /healthz see spec_net_gain (<= 0:
+                # the --engine-spec-k knob is a measured loss) without
+                # digging through the engine section
+                out["spec"] = eng["spec"]
             out["engine"] = eng
         return out
 

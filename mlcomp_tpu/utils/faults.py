@@ -24,8 +24,11 @@ e.g. ``worker.before_finish:kill:1,supervisor.tick:raise`` or
 Serving fault points (this repo's chaos surface, exercised by
 ``tools/chaoscheck.py``): ``engine.dispatch`` (raise = dispatch
 exception, sleep = wedged dispatch), ``engine.resolve`` (sleep = slow
-output readback), ``cache.lookup`` / ``cache.capture`` (raise =
-prefix-cache fault, contained to degraded-bypass / insert_errors).
+output readback), ``engine.fused_prefill`` (raise = host-side fault
+while preparing a fused admission chunk — contained to the admitting
+request; the decode fleet falls back to a plain dispatch),
+``cache.lookup`` / ``cache.capture`` (raise = prefix-cache fault,
+contained to degraded-bypass / insert_errors).
 
 Points are no-ops unless armed — zero overhead in production paths beyond
 an emptiness check and a dict lookup.

@@ -35,5 +35,10 @@ def test_chaoscheck_end_to_end():
     assert out["dispatch_stall"]["failed_in_s"] < 2.4
     assert out["cache_lookup_raise"] == "bypassed_exact"
     assert out["cache_capture_raise"] == "contained"
+    # a fault inside the fused admission path failed ONLY the admitting
+    # request: the streaming survivor stayed bit-identical and later
+    # admissions fused again
+    assert out["fused_prefill_raise"]["survivor_exact"]
     wd = out["final_health"]["watchdog"]
+    # the fused fault is admission-scoped: no extra stalls or restarts
     assert wd["stalls"] == 1 and wd["restarts"] == 2

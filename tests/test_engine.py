@@ -63,13 +63,17 @@ def test_engine_mid_decode_join_and_no_starvation():
     request finishes before a long one that started earlier (impossible
     under the window batcher, whose batches run to completion).
     K=1 keeps the round-4 per-token join bound; the K>1 bound has its
-    own test below.  pipeline_depth=1 pins the SYNCHRONOUS loop whose
-    tight bound this asserts; the depth-2 bound (one extra in-flight
-    dispatch) lives in test_engine_pipeline.py."""
+    own test below.  pipeline_depth=1 + staged admission pin the
+    SYNCHRONOUS loop whose tight bound this asserts (the fused default
+    trades one extra decode step of join latency for a never-pausing
+    decode stream — its bound lives in test_engine_fused_admit.py);
+    the depth-2 bound (one extra in-flight dispatch) lives in
+    test_engine_pipeline.py."""
     model, params = _model_and_params()
     eng = DecodeEngine(model, {"params": params}, slots=2,
                        prompt_buckets=(16,), max_new_cap=16,
-                       steps_per_dispatch=1, pipeline_depth=1)
+                       steps_per_dispatch=1, pipeline_depth=1,
+                       fused_admission=False)
     try:
         qa: "queue.Queue" = queue.Queue()
         fa = eng.submit([3, 14, 15, 9, 2], 12, stream=qa)

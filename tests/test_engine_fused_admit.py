@@ -1,0 +1,281 @@
+"""Fused prefill+decode dispatch (engine ``fused_admission``, default
+on): an admission's chunks ride the decode dispatches instead of
+running as lone dispatches at drained boundaries.  The acceptance
+contract: decode rows AND the admitted request's tokens are
+bit-identical between the fused and staged paths — on both cache
+layouts, across pipeline depths, through a prefix-cache hit landing
+mid-admission, and with EOS retiring a neighbour mid-prefill — and a
+fault inside the fused prep fails ONLY the admitting request."""
+
+import functools
+import queue
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlcomp_tpu.engine import DecodeEngine
+from mlcomp_tpu.models import create_model
+from mlcomp_tpu.models.generation import generate
+from mlcomp_tpu.serve import GenerationService
+from mlcomp_tpu.train.state import init_model
+from mlcomp_tpu.utils import faults
+
+
+@functools.lru_cache(maxsize=None)
+def _model_and_params(kv_quant=False, seed=0):
+    # cached across tests: init is deterministic per (kv_quant, seed)
+    # and nothing mutates the returned pytree
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 64, "hidden": 64,
+        "layers": 2, "heads": 2, "mlp_dim": 128, "dtype": "float32",
+        "kv_quant": kv_quant,
+    })
+    prompt = jnp.asarray(np.random.RandomState(seed).randint(1, 64, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(seed))
+    return model, params
+
+
+def _reference(model, params, ids, n_new, bucket=16, **kw):
+    prompt = np.full((1, bucket), 0, np.int32)
+    mask = np.zeros((1, bucket), bool)
+    prompt[0, bucket - len(ids):] = ids
+    mask[0, bucket - len(ids):] = True
+    out = generate(
+        model, {"params": params}, jnp.asarray(prompt), n_new,
+        prompt_mask=jnp.asarray(mask), **kw,
+    )
+    return np.asarray(out)[0, bucket:].tolist()
+
+
+IDS_A = [3, 14, 15, 9, 2]
+IDS_B = [7, 3, 44, 5, 6]
+
+# compiled-program cache across same-config engines (the bench.py
+# sharing idiom): fused/staged/pipeline-depth are host-side knobs, so
+# every engine a workload key builds runs the identical program set —
+# compile once per key instead of once per engine
+_FNS: dict = {}
+
+
+def _share_fns(eng, key):
+    eng._fns.update(_FNS.setdefault(key, {}))
+    return eng
+
+
+def _overlapped_workload(model, params, fused, depth=2, prefill_chunk=4,
+                         fns_key=None):
+    """A decodes while B's multi-chunk admission runs — with
+    prefill_chunk=4 in the 16 bucket, B (5 real tokens, start pad 11)
+    runs chunks 2 and 3, both overlapped with A's decode.  Returns the
+    comparable outputs plus the engine stats."""
+    eng = DecodeEngine(model, {"params": params}, slots=2,
+                       prompt_buckets=(16,), max_new_cap=12,
+                       steps_per_dispatch=2, pipeline_depth=depth,
+                       prefill_chunk=prefill_chunk,
+                       fused_admission=fused)
+    if fns_key is not None:
+        _share_fns(eng, fns_key)
+    try:
+        qa: "queue.Queue" = queue.Queue()
+        fa = eng.submit(IDS_A, 10, logprobs=True, stream=qa)
+        qa.get(timeout=300)                    # A is decoding
+        fb = eng.submit(IDS_B, 6, logprobs=True)
+        ra = fa.result(timeout=300)
+        rb = fb.result(timeout=300)
+        st = eng.stats()
+    finally:
+        if fns_key is not None:
+            _FNS[fns_key].update(eng._fns)
+        eng.close()
+    return {"a": (ra["ids"], ra["logprobs"]),
+            "b": (rb["ids"], rb["logprobs"])}, st
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_fused_bit_identical_to_staged(kv_quant):
+    """The acceptance equality: with B's admission overlapping A's
+    decode, fused and staged engines emit bit-identical tokens AND
+    logprobs for both the decode rows and the admitted request (its
+    first token comes from the fused program's chunk half), on both
+    cache layouts — and both match bare generate."""
+    model, params = _model_and_params(kv_quant)
+    key = ("workload", kv_quant)
+    fused, st_f = _overlapped_workload(model, params, True, fns_key=key)
+    staged, st_s = _overlapped_workload(model, params, False, fns_key=key)
+    assert fused == staged
+    assert fused["a"][0] == _reference(model, params, IDS_A, 10)
+    assert fused["b"][0] == _reference(model, params, IDS_B, 6)
+    # counter contract: a fused chunk counts exactly like a staged one
+    # (no double count), and the overlapped admission is recorded
+    assert st_f["prefill_chunks"] == st_s["prefill_chunks"]
+    assert st_f["prefills"] == st_s["prefills"] == 2
+    assert st_f["fused_chunks"] == 2        # B's two run chunks
+    assert st_f["admissions_overlapped"] == 1
+    assert st_s["fused_chunks"] == 0
+    assert st_s["admissions_overlapped"] == 0
+    assert st_f["fused_admission"] is True
+    assert st_s["fused_admission"] is False
+
+
+def test_fused_depth1_vs_depth2():
+    """The fused path composes with the dispatch pipeline: depth 1 and
+    depth 2 emit identical outputs with an admission in flight."""
+    model, params = _model_and_params()
+    key = ("workload", False)
+    d1, _ = _overlapped_workload(model, params, True, depth=1, fns_key=key)
+    d2, _ = _overlapped_workload(model, params, True, depth=2, fns_key=key)
+    assert d1 == d2
+
+
+def test_prefix_cache_hit_mid_admission_fused():
+    """A prefix-cache hit landing mid-admission keeps its
+    chunk-skipping semantics on the fused path: the suffix chunk rides
+    a decode dispatch, tokens stay exact vs the cold run and vs the
+    staged engine, and hit accounting is identical."""
+    from mlcomp_tpu.cache import PrefixKVCache
+
+    model, params = _model_and_params()
+    shared = [9, 10, 11, 12, 13, 14, 15, 16, 17]   # 9 real tokens
+    results = {}
+    for fused in (True, False):
+        cache = PrefixKVCache(max_bytes=1 << 22)
+        eng = _share_fns(
+            DecodeEngine(model, {"params": params}, slots=2,
+                         prompt_buckets=(16,), max_new_cap=12,
+                         steps_per_dispatch=2, prefill_chunk=4,
+                         prefix_cache=cache, fused_admission=fused),
+            ("workload", False),   # same program set as the workload
+        )
+        try:
+            cold = eng.submit(shared, 6).result(timeout=300)
+            cache.flush()                 # capture lands in the trie
+            qa: "queue.Queue" = queue.Queue()
+            fa = eng.submit(IDS_A, 10, stream=qa)
+            qa.get(timeout=300)           # A is decoding
+            hit = eng.submit(shared, 6).result(timeout=300)
+            ra = fa.result(timeout=300)
+            st = eng.stats()
+        finally:
+            _FNS[("workload", False)].update(eng._fns)
+            eng.close()
+        assert cold["cache_hit_tokens"] == 0
+        # 9 tokens, start pad 7, chunk 4: hit covers through chunk 2's
+        # boundary (12 slots) -> 5 prompt tokens skip their prefill
+        assert hit["cache_hit_tokens"] == 5, hit
+        assert hit["ids"] == cold["ids"]
+        results[fused] = (cold["ids"], hit["ids"], ra["ids"], st["prefills"])
+    assert results[True] == results[False]
+    assert results[True][0] == _reference(model, params, shared, 6)
+
+
+def test_eos_during_overlapped_admission():
+    """A hits EOS while B's fused admission is mid-flight: A's slot
+    frees and its stream terminates correctly, B's insert still lands,
+    and everything matches the staged path."""
+    model, params = _model_and_params()
+    # A stops at its second greedy token (deterministic reference)
+    eos_a = _reference(model, params, IDS_A, 2)[1]
+    results = {}
+    for fused in (True, False):
+        eng = _share_fns(
+            DecodeEngine(model, {"params": params}, slots=2,
+                         prompt_buckets=(16,), max_new_cap=12,
+                         steps_per_dispatch=1, prefill_chunk=2,
+                         fused_admission=fused),
+            ("eos", 1, 2),
+        )
+        try:
+            qa: "queue.Queue" = queue.Queue()
+            fa = eng.submit(IDS_A, 12, eos_id=eos_a, stream=qa)
+            qa.get(timeout=300)           # A is decoding
+            fb = eng.submit(IDS_B, 6)     # 6+ chunks of 2: a long prefill
+            ra = fa.result(timeout=300)
+            rb = fb.result(timeout=300)
+        finally:
+            _FNS[("eos", 1, 2)].update(eng._fns)
+            eng.close()
+        assert ra["ids"][-1] == eos_a and len(ra["ids"]) == 2, ra
+        results[fused] = (ra["ids"], rb["ids"])
+    assert results[True] == results[False]
+    assert results[True][1] == _reference(model, params, IDS_B, 6)
+
+
+def test_fused_prefill_fault_fails_only_the_admission():
+    """The engine.fused_prefill chaos point (host-side prep, before the
+    combined device call): the admitting request fails with the fault,
+    the decode fleet's tokens stay bit-identical to a fault-free run,
+    the engine stays healthy, and the next admission succeeds."""
+    model, params = _model_and_params()
+    ref_a = _reference(model, params, IDS_A, 10)
+    ref_b = _reference(model, params, IDS_B, 6)
+    eng = _share_fns(
+        DecodeEngine(model, {"params": params}, slots=2,
+                     prompt_buckets=(16,), max_new_cap=12,
+                     steps_per_dispatch=2, prefill_chunk=4),
+        ("workload", False),
+    )
+    try:
+        qa: "queue.Queue" = queue.Queue()
+        fa = eng.submit(IDS_A, 10, stream=qa)
+        qa.get(timeout=300)               # A is decoding
+        faults.arm("engine.fused_prefill", flavor="raise", times=1)
+        fb = eng.submit(IDS_B, 6)
+        with pytest.raises(faults.FaultInjected):
+            fb.result(timeout=300)
+        # survivor exact, engine alive, no admission state leaked
+        assert fa.result(timeout=300)["ids"] == ref_a
+        assert eng.healthy
+        assert eng._adm is None
+        # the slot the failed admission never took is still usable
+        rb = eng.submit(IDS_B, 6).result(timeout=300)
+        assert rb["ids"] == ref_b
+        st = eng.stats()
+        assert st["prefills"] == 2        # A + the retry, not the fault
+        assert st["active_slots"] == 0 or st["active_slots"] == 1
+    finally:
+        faults.disarm_all()
+        eng.close()
+
+
+def test_staged_flag_plumbing_and_metrics():
+    """--engine-staged-admission plumbing: the service forwards
+    engine_fused_admission (rejected off the continuous batcher), the
+    engine reports the mode in stats(), and the new admission metrics
+    (fused chunk / overlap counters + the stall histogram) are in the
+    exposition."""
+    model, params = _model_and_params()
+    svc = GenerationService(
+        model, {"params": params}, batch_sizes=(1, 2),
+        prompt_buckets=(16,), max_new_buckets=(8,),
+        engine_fused_admission=False,
+    )
+    try:
+        assert svc.engine.fused_admission is False
+        svc.generate([5, 6, 7], 4)
+        assert svc.stats()["engine"]["fused_admission"] is False
+        text = svc.metrics.render()
+        for name in ("mlcomp_engine_fused_prefill_chunks_total",
+                     "mlcomp_engine_admissions_overlapped_total",
+                     "mlcomp_engine_admission_stall_ms_bucket"):
+            assert name in text, name
+    finally:
+        svc.close()
+    with pytest.raises(ValueError, match="continuous"):
+        GenerationService(
+            model, {"params": params}, batcher="window", batch_sizes=(1,),
+            prompt_buckets=(16,), max_new_buckets=(8,),
+            engine_fused_admission=False,
+        )
+    # default is fused; warmup precompiles the fused program family
+    svc = GenerationService(
+        model, {"params": params}, batch_sizes=(1, 2),
+        prompt_buckets=(16,), max_new_buckets=(8,),
+    )
+    try:
+        assert svc.engine.fused_admission is True
+        assert svc.engine.warm_fused_fns() == 1   # one chunk width
+        assert ("fused_dispatch", 16) in svc.engine._fns
+    finally:
+        svc.close()

@@ -22,6 +22,11 @@ watchdog armed):
   tokens, ``cache_hit_tokens`` 0, ``cache_degraded`` counted;
 - **cache capture raise** (``cache.capture``): contained to
   ``insert_errors`` on the capture worker; serving continues.
+- **fused-prefill raise** (``engine.fused_prefill``): a fault while an
+  admission chunk is being fused into the decode dispatch fails ONLY
+  the admitting request — the streaming survivor's tokens stay
+  bit-identical (its boundary falls back to a plain decode dispatch),
+  nothing leaks, and the next admission succeeds.
 
 Recovery invariants asserted after EVERY scenario:
 
@@ -105,6 +110,37 @@ class _Daemon:
                 return r.status, json.loads(r.read())
         except urllib.error.HTTPError as e:
             return e.code, json.loads(e.read())
+
+    def open_stream(self, ids, n_new=8, timeout=120):
+        """POST /generate with "stream": true; returns the open SSE
+        response (headers are sent before the first token, so the row
+        keeps decoding while the caller does other work)."""
+        body = {"prompt": list(ids), "max_new_tokens": n_new,
+                "stream": True}
+        req = urllib.request.Request(
+            f"{self.base}/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    @staticmethod
+    def read_stream(resp):
+        """Drain an SSE response -> (token list, final result dict);
+        raises on an error event (the stream under test must survive)."""
+        toks, final = [], None
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            item = json.loads(line[len("data: "):])
+            if "error" in item:
+                raise AssertionError(f"stream errored: {item}")
+            if item.get("done"):
+                final = item
+                break
+            toks.append(item["token"])
+        resp.close()
+        return toks, final
 
     def healthz(self):
         try:
@@ -266,6 +302,54 @@ def run() -> dict:
         assert drive_baseline("after_capture_fault") == baseline
         d.assert_drained("cache_capture")
         out["cache_capture_raise"] = "contained"
+
+        # ---- scenario 5: fused-prefill raise -> only the admission dies
+        # A streams (holding a decode row) while B admits: B's chunk
+        # rides A's decode dispatch, so the armed fault fails B —
+        # never A, whose boundary falls back to a plain dispatch.  The
+        # overlap is a race against A finishing; retry a few times and
+        # require at least one armed attempt to engage.
+        a_cold, _ = d.read_stream(d.open_stream(prompts[0], 8))
+        d.svc.prefix_cache.flush()
+        fused0 = d.svc.engine.stats()["fused_chunks"]
+        engaged = False
+        for attempt in range(5):
+            faults.arm("engine.fused_prefill", flavor="raise", times=1)
+            resp = d.open_stream(prompts[0], 8)
+            code, payload = d.generate(prompts[1], timeout=60)
+            a_toks, _ = d.read_stream(resp)
+            # the survivor is bit-identical whether or not the race won
+            assert a_toks == a_cold, (attempt, a_toks, a_cold)
+            if code == 500 and "FaultInjected" in payload.get("error", ""):
+                engaged = True
+                break
+            faults.disarm_all()   # race lost: B admitted unfused
+            assert code == 200 and payload["ids"] == baseline[1], (
+                attempt, code, payload,
+            )
+        assert engaged, "fused-prefill fault never engaged an admission"
+        d.wait_healthy()
+        # the fleet keeps FUSING after the contained fault: replay the
+        # overlap fault-free until an admission actually rides a decode
+        # dispatch, with exact tokens on both sides
+        refused = False
+        for _ in range(5):
+            resp = d.open_stream(prompts[0], 8)
+            code, payload = d.generate(prompts[1], timeout=60)
+            a_toks, _ = d.read_stream(resp)
+            assert a_toks == a_cold, (a_toks, a_cold)
+            assert code == 200 and payload["ids"] == baseline[1], (
+                code, payload,
+            )
+            if d.svc.engine.stats()["fused_chunks"] > fused0:
+                refused = True
+                break
+        assert refused, "no fused admission engaged after the fault"
+        assert drive_baseline("after_fused_fault") == baseline
+        d.assert_drained("fused_prefill")
+        out["fused_prefill_raise"] = {
+            "attempts": attempt + 1, "survivor_exact": True,
+        }
 
         code, h = d.healthz()
         assert code == 200 and h["ok"], (code, h)

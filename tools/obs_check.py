@@ -46,6 +46,9 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_engine_emitted_tokens_total",
     "mlcomp_engine_prefills_total",
     "mlcomp_engine_prefill_chunks_total",
+    "mlcomp_engine_fused_prefill_chunks_total",
+    "mlcomp_engine_admissions_overlapped_total",
+    "mlcomp_engine_admission_stall_ms",
     "mlcomp_engine_latency_samples_total",
     "mlcomp_engine_slots",
     "mlcomp_engine_active_slots",
