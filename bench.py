@@ -93,6 +93,37 @@ WARMUP = int(os.environ.get("MLCOMP_BENCH_WARMUP", "5"))
 STEPS = int(os.environ.get("MLCOMP_BENCH_STEPS", "30"))
 WINDOWS = int(os.environ.get("MLCOMP_BENCH_WINDOWS", "5"))
 
+# Bench TIERS (BENCH_r05 hit the driver budget: rc=124 dropped lines
+# from the record).  The default "headline" tier runs every headline
+# metric line — nothing a regression gate depends on is skipped — but
+# the engine line's sweep/A-B sub-blocks (pipeline depth A/B,
+# fused-admission A/B + equality probes, flight-recorder A/B,
+# resilience A/B, batched-spec sweep) only run at BENCH_TIER=full:
+# each spins extra engines/compiles whose cost is what blew the
+# budget.  Per-block MLCOMP_BENCH_SKIP_* envs still win in both
+# directions: "1"/"true" skips a block even at full tier, "0"/"false"
+# forces one on at headline tier.
+BENCH_TIER = (
+    os.environ.get("BENCH_TIER", "").strip().lower() or "headline"
+)
+if BENCH_TIER not in ("headline", "full"):
+    raise SystemExit(
+        f"BENCH_TIER must be 'headline' or 'full', got {BENCH_TIER!r}"
+    )
+
+
+def _block_on(flag: str, full_tier_only: bool = True) -> bool:
+    """Gate for a sweep/A-B sub-block: explicit env wins ('1'/'true'
+    skip, '0'/'false' force), else full-tier-only blocks run only at
+    BENCH_TIER=full."""
+    v = os.environ.get(flag, "").strip().lower()
+    if v in ("1", "true"):
+        return False
+    if v in ("0", "false"):
+        return True
+    return BENCH_TIER == "full" or not full_tier_only
+
+
 LM_BATCH = int(os.environ.get("MLCOMP_BENCH_LM_BATCH", "2"))
 LM_SEQ = int(os.environ.get("MLCOMP_BENCH_LM_SEQ", "4096"))
 LM_HIDDEN = int(os.environ.get("MLCOMP_BENCH_LM_HIDDEN", "2048"))
@@ -629,15 +660,6 @@ def bench_engine(scan_variants=None) -> "dict | None":
         ),
     }
 
-    # ASYNC DISPATCH PIPELINE A/B (this PR): the same K=8 program
-    # driven depth-1 (issue + resolve synchronously — the old loop)
-    # vs depth-2 (issue dispatch N+1 before resolving N's outputs —
-    # classic double buffering on the donated carry chain).  The depth
-    # delta is host overhead HIDDEN behind device compute, so
-    # overlap_efficiency = (d1 - d2) / measured per-dispatch host
-    # overhead: 1.0 means the pipeline hid all of it.  Interleaved
-    # windows on a freshly re-admitted full fleet, same tunnel-safe
-    # methodology as the K sweep above.
     def reset_fleet(eng):
         """Retire the current occupants (budgets nearly spent), then
         re-admit a fresh 8-slot fleet so a measurement arm sees
@@ -656,9 +678,117 @@ def bench_engine(scan_variants=None) -> "dict | None":
                 eng._run_admission_chunk()
         eng._run_dispatch()  # settle into steady state
 
-    if os.environ.get("MLCOMP_BENCH_SKIP_PIPELINE", "") not in (
-        "1", "true"
-    ):
+    # DEVICE-TIME ATTRIBUTION (observability PR, both tiers): the
+    # xplane methodology, live on the engine's real dispatch programs
+    # via the dependency-free reader (obs/devprof.py) — one profiled
+    # dispatch per K, device-lane interval union vs host wall.  This is
+    # the block that splits the ~21% roofline gap into device vs host
+    # per dispatch family instead of inferring it from marginals: the
+    # device side is trustworthy through the tunnel (per-event device
+    # durations are device-stamped), host_gap is tunnel-inflated and
+    # says so.  Also gates the PROFILING-OFF cost: the serve engine now
+    # runs a per-boundary _profile_tick (a None check when disarmed) —
+    # its direct per-call cost must stay <1% of dispatch wall, and a
+    # post-capture dispatch re-run proves captures leave no residue.
+    if _block_on("MLCOMP_BENCH_SKIP_DEVPROF", full_tier_only=False):
+        import shutil
+        import tempfile
+
+        from mlcomp_tpu.obs import devprof
+
+        roof_tok_s = None
+        if scan_variants and "b8_kv8_int8" in scan_variants:
+            roof_tok_s = scan_variants["b8_kv8_int8"][
+                "roofline_tokens_per_sec"
+            ]
+        fams = {}
+        for K, eng in engines.items():
+            # no fleet reset: dispatch cost is slot-static (the scan
+            # runs every lane, active or not), and retiring/re-admitting
+            # a K=1 fleet would cost hundreds of tunnel dispatches
+            eng._run_dispatch()  # settle
+            trace_dir = tempfile.mkdtemp(prefix=f"mlcomp_devprof_k{K}_")
+            try:
+                # time only the dispatch: profiler start/stop and the
+                # xplane dump are fixed one-shot costs that would
+                # otherwise dominate host_gap for a single dispatch
+                with jax.profiler.trace(trace_dir):
+                    t0 = time.perf_counter()
+                    eng._run_dispatch()
+                    wall_ms = (time.perf_counter() - t0) * 1e3
+                planes = devprof.load_xspace(
+                    devprof.find_xplane(trace_dir)
+                )
+                att = devprof.attribution(
+                    planes, wall_ms=wall_ms, top_kernels=6
+                )
+            finally:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+            dev_ms = att["device_time_ms"]
+            toks = 8 * K  # slots x steps per dispatch
+            dev_tok_s = toks / (dev_ms / 1e3) if dev_ms > 0 else None
+            fams[f"decode_scan_k{K}"] = {
+                "device_time_ms": round(dev_ms, 3),
+                "host_gap_ms": att["host_gap_ms"],
+                "wall_ms": round(wall_ms, 3),
+                "device_tokens_per_sec": (
+                    round(dev_tok_s, 1) if dev_tok_s else None
+                ),
+                # measured device throughput against the decode
+                # headline's HBM roofline: the DEVICE half of the gap;
+                # whatever remains to the end-to-end number is host
+                "roofline_utilization": (
+                    round(dev_tok_s / roof_tok_s, 4)
+                    if dev_tok_s and roof_tok_s else None
+                ),
+                "kernels": att["kernels"][:5],
+            }
+        # profiling-off overhead: the disarmed per-boundary check,
+        # measured directly (the A/B noise floor through the tunnel is
+        # bigger than the budget under test), plus a paired post-
+        # capture dispatch wall vs the pre-capture w8 median
+        eng8 = engines[8]
+        n_ops = 20000
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            eng8._profile_tick()
+        per_tick_ms = (time.perf_counter() - t0) / n_ops * 1e3
+        tick_pct = per_tick_ms / (w8 * 1e3) * 100 if w8 > 0 else 0.0
+        post_walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            eng8._run_dispatch()
+            post_walls.append(time.perf_counter() - t0)
+        post_ms = statistics.median(post_walls) * 1e3
+        post_pct = (post_ms / (w8 * 1e3) - 1.0) * 100 if w8 > 0 else 0.0
+        line["device_attribution"] = {
+            "families": fams,
+            "roofline_tokens_per_sec": roof_tok_s,
+            "profiling_off": {
+                "per_tick_ms": round(per_tick_ms, 6),
+                "direct_overhead_pct": round(tick_pct, 4),
+                "post_capture_dispatch_wall_ms": round(post_ms, 3),
+                "post_capture_delta_pct": round(post_pct, 3),
+                # the gate: the disarmed check is measured <1% of
+                # dispatch wall, or the post-capture paired read is
+                # (tunnel drift can swamp either individually)
+                "within_1pct_budget": bool(
+                    tick_pct < 1.0 or post_pct < 1.0
+                ),
+            },
+        }
+
+    # ASYNC DISPATCH PIPELINE A/B (this PR): the same K=8 program
+    # driven depth-1 (issue + resolve synchronously — the old loop)
+    # vs depth-2 (issue dispatch N+1 before resolving N's outputs —
+    # classic double buffering on the donated carry chain).  The depth
+    # delta is host overhead HIDDEN behind device compute, so
+    # overlap_efficiency = (d1 - d2) / measured per-dispatch host
+    # overhead: 1.0 means the pipeline hid all of it.  Interleaved
+    # windows on a freshly re-admitted full fleet, same tunnel-safe
+    # methodology as the K sweep above (reset_fleet is defined above
+    # the device-attribution block).
+    if _block_on("MLCOMP_BENCH_SKIP_PIPELINE"):
         eng8 = engines[8]
         reset_fleet(eng8)
         walls_p = {1: [], 2: []}
@@ -722,9 +852,7 @@ def bench_engine(scan_variants=None) -> "dict | None":
     # dispatch vs a plain dispatch).  admission_stall_ms.fused is the
     # worst of the two marginals; the equality probe below proves the
     # fused path moves time, never tokens.
-    if os.environ.get("MLCOMP_BENCH_SKIP_FUSED_ADMIT", "") not in (
-        "1", "true"
-    ):
+    if _block_on("MLCOMP_BENCH_SKIP_FUSED_ADMIT"):
         eng8 = engines[8]
         reset_fleet(eng8)
 
@@ -835,7 +963,7 @@ def bench_engine(scan_variants=None) -> "dict | None":
     # every other A/B here — tunnel drift (±3.5%) dwarfs the real
     # overhead (~5 dict appends/dispatch), so a single window could
     # read as a regression by luck.
-    if os.environ.get("MLCOMP_BENCH_SKIP_OBS", "") not in ("1", "true"):
+    if _block_on("MLCOMP_BENCH_SKIP_OBS"):
         from mlcomp_tpu.utils.trace import Tracer, null_tracer
 
         eng8 = engines[8]
@@ -907,9 +1035,7 @@ def bench_engine(scan_variants=None) -> "dict | None":
     # nothing armed, nothing queued, no deadlines — the steady-state
     # fast path a healthy fleet pays).  Same interleaved alternating
     # windows + direct per-call tie-breaker as the recorder A/B.
-    if os.environ.get("MLCOMP_BENCH_SKIP_RESILIENCE", "") not in (
-        "1", "true"
-    ):
+    if _block_on("MLCOMP_BENCH_SKIP_RESILIENCE"):
         eng8 = engines[8]
 
         def arm_fleet():
@@ -975,9 +1101,7 @@ def bench_engine(scan_variants=None) -> "dict | None":
     # dispatch cost next to the K-step scan dispatch above.  The
     # tunnel overhead estimate reuses the non-spec engine's measured
     # split (same one-call + one-fetch host path).
-    if os.environ.get("MLCOMP_BENCH_SKIP_ENGINE_SPEC", "") not in (
-        "1", "true"
-    ):
+    if _block_on("MLCOMP_BENCH_SKIP_ENGINE_SPEC"):
         # spec_k=7: the verify's GEMMs run slots*(K+1) rows, and 8x8=64
         # stays within the int8 kernel's measured fat-block decode
         # boundary (_GEMV_ROWS — K=8 would put 72 rows onto the
@@ -1035,6 +1159,7 @@ def bench_engine(scan_variants=None) -> "dict | None":
                 "step cost below the tunnel measurement floor"
             )
         line["engine_spec"] = spec
+    line["tier"] = BENCH_TIER
     print(json.dumps(line))
     # the prefix-cache line reuses the weights AND the K=8 engine's
     # compiled programs (prefill/insert/dispatch are config-identical)

@@ -113,6 +113,7 @@ No upstream analog: the reference framework has no serving path at all.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -150,6 +151,15 @@ class EngineStalled(RuntimeError):
     error."""
 
     status = "engine_stalled"
+
+
+class ProfileBusy(RuntimeError):
+    """A second ``profile()`` arrived while a device capture was
+    already armed or mid-window — one capture at a time (the
+    ``jax.profiler`` session is process-global).  HTTP maps this to
+    409."""
+
+    status = "profile_busy"
 
 
 def _fail_future(fut: Future, err: Exception) -> None:
@@ -457,6 +467,7 @@ class DecodeEngine:
             "fused_chunks": 0, "admissions_overlapped": 0,
             "deadline_exceeded": 0, "cancelled": 0, "cache_degraded": 0,
             "watchdog_stalls": 0, "watchdog_restarts": 0,
+            "profile_captures": 0,
         }
         if self.spec_k is not None:
             # spec-honesty denominator: live row-forwards across spec
@@ -527,7 +538,40 @@ class DecodeEngine:
             "boundary; ~0 when every chunk rides a fused dispatch)",
             buckets=DEFAULT_MS_BUCKETS,
         )
+        self._hist_device = self.metrics.histogram(
+            "mlcomp_engine_device_time_ms",
+            "Device-lane busy ms per dispatch (one observation per "
+            "/profile capture: xplane interval union / dispatches)",
+            buckets=DEFAULT_MS_BUCKETS,
+        )
         self.metrics.register_collector(self._collect_metrics)
+        # on-demand device capture (GET /profile): one armed/active
+        # request at a time — HTTP threads arm under _prof_lock, the
+        # loop thread starts/stops/attributes it at dispatch boundaries
+        self._prof_lock = threading.Lock()
+        self._profile: Optional[Dict[str, Any]] = None
+        self._last_attr: Optional[Dict[str, Any]] = None
+        # HBM-roofline accounting for the device-time attribution: one
+        # decode forward streams the full weight tree plus the whole
+        # allocated KV buffer (XLA attends the masked buffer; the
+        # Pallas kernels clamp at the cursor, so the count is
+        # conservative for them) — K forwards per scan dispatch, one
+        # per spec verify.  Shape metadata only: never touches (soon
+        # to be donated) device buffers.
+        w_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self.variables)
+        )
+        kv_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self._dstate["cache"])
+        )
+        forwards = 1 if self.spec_k is not None else self.steps_per_dispatch
+        self._hbm_gbps = float(os.environ.get("MLCOMP_TPU_HBM_GBPS", "819"))
+        self._roofline_bytes = forwards * (w_bytes + kv_bytes)
+        self._roofline_ms = (
+            self._roofline_bytes / (self._hbm_gbps * 1e9) * 1e3
+        )
         self.step_count = 0
         self._fns: Dict[Any, Any] = {}
         # chunk widths whose fused program has COMPILED AND RUN once
@@ -752,6 +796,89 @@ class DecodeEngine:
             return False
         return True
 
+    def profile(self, dispatches: int = 8,
+                trace_dir: Optional[str] = None) -> Future:
+        """Arm a windowed device-profile capture around the next
+        ``dispatches`` dispatch boundaries (``GET /profile``).  The
+        drive loop starts a ``jax.profiler`` trace at the next boundary
+        with decode work (the ``utils/profile.StepProfiler`` window
+        idiom, fed the resolved-dispatch count), stops it behind a real
+        device barrier after N dispatches, parses the xplane with the
+        dependency-free reader (``obs/devprof.py``), and resolves the
+        returned Future with the attribution dict: ``device_time_ms``
+        (interval union over device lanes), ``host_gap_ms`` (wall the
+        device sat idle — dispatch cost, pipeline bubble, admission
+        stall), the kernel-name breakdown, and per-dispatch-family
+        roofline utilization.  The device spans also merge into the
+        flight recorder as the ``engine.device`` track, so a
+        ``GET /trace`` after the capture renders host issue/resolve
+        spans aligned above the device programs they launched.
+
+        One capture at a time (the profiler session is process-global):
+        a concurrent second arm raises :class:`ProfileBusy` (HTTP 409).
+        Capture failures fail THIS future only — never the fleet."""
+        n = int(dispatches)
+        if not 1 <= n <= 1024:
+            # the xplane parse + track merge run ON the loop thread at
+            # the window close (a deliberate, bounded stall — it is an
+            # explicit operator request); the cap keeps that stall
+            # proportionate.  8 dispatches already attribute well.
+            raise ValueError(
+                f"dispatches must be in [1, 1024], got {dispatches}"
+            )
+        if self._broken is not None:
+            raise RuntimeError(
+                f"decode engine is down: {self._broken!r}"
+            ) from self._broken
+        if self._stop.is_set():
+            raise RuntimeError("decode engine closed")
+        import tempfile
+
+        from mlcomp_tpu.utils.profile import StepProfiler
+
+        fut: Future = Future()
+        with self._prof_lock:
+            if self._profile is not None:
+                raise ProfileBusy(
+                    "a device-profile capture is already armed or in "
+                    "flight; retry after it resolves"
+                )
+            d = trace_dir or tempfile.mkdtemp(prefix="mlcomp_devprof_")
+            self._profile = {
+                "n": n, "dir": d, "future": fut,
+                "owns_dir": trace_dir is None,
+                "profiler": StepProfiler(d, start_step=0, num_steps=n),
+                "families": {}, "t0": None, "t1": None, "resolved": 0,
+            }
+        if self._stop.is_set() or self._broken is not None:
+            # close() (or a dying loop) may have run its profile drain
+            # between the checks above and our arm — the same race
+            # submit() re-checks after its enqueue.  Resolve ourselves
+            # (idempotent: whoever also saw it loses the _fail race).
+            self._finish_profile(
+                error=self._broken or RuntimeError("decode engine closed")
+            )
+        return fut
+
+    def profile_cancel(self, fut: Future) -> bool:
+        """Best-effort disarm of a capture that has NOT started tracing
+        (the HTTP layer's client-timeout path).  An active capture is
+        never cancelled from outside — the loop thread owns the open
+        trace and will close it at its window boundary."""
+        with self._prof_lock:
+            pr = self._profile
+            if pr is None or pr["future"] is not fut:
+                return False
+            if pr["profiler"].active:
+                return False
+            self._profile = None
+        _fail_future(fut, RuntimeError("profile capture cancelled"))
+        if pr.get("owns_dir"):
+            import shutil
+
+            shutil.rmtree(pr["dir"], ignore_errors=True)
+        return True
+
     @property
     def healthy(self) -> bool:
         """False once the drive loop is broken, abandoned, or dead
@@ -840,6 +967,11 @@ class DecodeEngine:
             "ttft_ms": self._percentiles(self._lat_ttft),
             "per_token_ms": self._percentiles(self._lat_tok),
         }
+        # device-time attribution: the last /profile capture's measured
+        # split when one ran, else the cheap steady-state estimate —
+        # the host-overhead/device split behind /healthz and the
+        # roofline gauges
+        out["device"] = self._device_summary()
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
         return out
@@ -924,6 +1056,27 @@ class DecodeEngine:
             p["hidden_ms"] / busy if busy > 0 else 0.0)
         ctr("mlcomp_engine_trace_events_dropped_total",
             "Flight-recorder ring evictions", self.recorder.dropped)
+        ctr("mlcomp_engine_profile_captures_total",
+            "On-demand device-profile captures completed (/profile)",
+            st["profile_captures"])
+        dev = self._device_summary()
+        if dev["device_time_ms_per_dispatch"] is not None:
+            gau("mlcomp_engine_device_time_ms_per_dispatch",
+                "Device-lane busy ms per dispatch (last capture, else "
+                "the steady-state estimate: dispatch wall minus "
+                "measured host work)",
+                dev["device_time_ms_per_dispatch"])
+        if dev["host_overhead_ms_per_dispatch"] is not None:
+            gau("mlcomp_engine_host_overhead_ms_per_dispatch",
+                "Non-device ms per dispatch (capture host gap, else "
+                "the pipeline's measured hidden host work)",
+                dev["host_overhead_ms_per_dispatch"])
+        if dev["roofline_utilization"] is not None:
+            gau("mlcomp_engine_roofline_utilization",
+                "HBM-roofline dispatch time / measured device time "
+                "(1.0 = decode runs at what the memory system can "
+                "deliver)",
+                dev["roofline_utilization"])
         if self.prefix_cache is not None:
             cs = self.prefix_cache.stats()
             for key in ("lookups", "hits", "misses", "matched_tokens",
@@ -980,10 +1133,16 @@ class DecodeEngine:
                 stacklevel=2,
             )
             self._drain_queue(err)
+            pr = self._profile
+            if pr is not None:
+                # fail the waiter but leave profiler state alone: the
+                # wedged loop still owns any open trace session
+                _fail_future(pr["future"], self._broken)
             return
         # thread exited: nobody may be left waiting on a future/stream
         # that will never resolve — fail in-flight rows, the loop's
         # pending deque (safe now: its owner is dead), and the queue
+        self._finish_profile(error=err)  # backstop; loop's drain is first
         for i in range(self.slots):
             self._finish(i, error=err)
         self._fail_admission(err)
@@ -1719,6 +1878,303 @@ class DecodeEngine:
             while self._inflight:
                 self._process_oldest()
 
+    # ------------------------------------------------- device profiling
+
+    def _family_name(self, fused_chunk: Optional[int] = None) -> str:
+        """The dispatch-program family a capture attributes to: the
+        K-step scan or the spec verify, with the fused prefill+decode
+        width as a suffix when an admission chunk rode the dispatch."""
+        base = (
+            f"spec_verify_k{self.spec_k}" if self.spec_k is not None
+            else f"decode_scan_k{self.steps_per_dispatch}"
+        )
+        if fused_chunk is not None:
+            return f"{base}+prefill_c{fused_chunk}"
+        return base
+
+    def _profile_tick(self) -> None:
+        """Loop-thread: advance the armed/active on-demand capture at
+        this dispatch boundary.  Start only once there is decode work
+        to record, at a clean boundary (in-flight dispatches from
+        before the window drained); stop behind a device barrier after
+        N dispatches — or early if traffic drained, reporting the
+        dispatches that actually ran.  Capture failures are
+        PROFILE-scoped (they fail the capture future, never the
+        fleet); only the shared inflight drains may raise out of
+        here, and those are genuinely engine-level."""
+        pr = self._profile
+        if pr is None:
+            return
+        prof = pr["profiler"]
+        if not prof.active:
+            # arm -> start once there is ANY device work to record: a
+            # pending/in-progress admission counts (its prefill chunks
+            # are device compute inside the window), not just live
+            # decode rows — with short requests whose whole decode fits
+            # one in-flight dispatch, waiting for live rows at a
+            # boundary would never fire (the pre-window drain retires
+            # the fleet every time)
+            if not (self._adm is not None or self._pending
+                    or any(s is not None for s in self._host)):
+                return  # stay armed until traffic arrives
+            self._drain_inflight()  # pre-window work resolves OUTSIDE
+            start_err: Optional[Exception] = None
+            with self._prof_lock:
+                if self._profile is not pr:
+                    return  # cancelled between the read and the start
+                try:
+                    prof.step(0)  # opens the jax.profiler trace window
+                except Exception as e:
+                    start_err = e
+            if start_err is not None:
+                self._finish_profile(error=start_err)
+                return
+            pr["t0"] = time.perf_counter()
+            pr["resolved0"] = self._stats["dispatches"]
+            self.recorder.instant(
+                "profile_start", track="engine.loop", dispatches=pr["n"],
+            )
+            return
+        resolved = self._stats["dispatches"] - pr["resolved0"]
+        # idle mirrors the start gate: pending/in-progress admissions
+        # are traffic too — a window must not close early while a
+        # joiner is queued at this very boundary
+        idle = (
+            not self._inflight and self._adm is None
+            and not self._pending
+            and all(s is None for s in self._host)
+        )
+        # an open window closes when full — or early when traffic
+        # drained, but only once it holds at least one dispatch
+        if resolved < pr["n"] and not (idle and resolved > 0):
+            if idle:
+                # resolved == 0 and NOTHING left (no rows, admission,
+                # pending, or inflight): the traffic that opened the
+                # window was retired before a single dispatch resolved
+                # (joiner deadline/cancel/failure).  Close and fail
+                # rather than holding the process-global profiler
+                # session — and every later /profile — hostage until
+                # unrelated traffic arrives.
+                self._finish_profile(error=RuntimeError(
+                    "capture window closed empty: the traffic that "
+                    "opened it was retired before any dispatch resolved"
+                ))
+            return
+        self._drain_inflight()
+        pr["resolved"] = self._stats["dispatches"] - pr["resolved0"]
+        # block on the carry OURSELVES (a real device barrier — without
+        # it the device would still be executing the profiled window
+        # when the trace closes) and stamp t1 BEFORE the stop:
+        # stop_trace's collection/serialization wall is neither
+        # dispatch cost nor bubble, so it must not inflate host_gap_ms.
+        # Busy time to the watchdog like every other potentially-
+        # wedging device call on this thread.
+        self._busy_since = time.perf_counter()
+        try:
+            self._jax.block_until_ready(self._dstate["last_logits"])
+            pr["t1"] = time.perf_counter()
+            prof.step(prof.stop_step)
+        except Exception as e:
+            self._finish_profile(error=e)
+            return
+        finally:
+            self._busy_since = None
+        self._finish_profile()
+
+    def _finish_profile(self, error: Optional[Exception] = None) -> None:
+        """Complete (or abort) the in-flight capture: close the trace
+        window if still open, parse + attribute on success, clean the
+        capture dir, resolve the future.  Never raises — it runs on
+        every teardown path (loop death, close, parse failure)."""
+        with self._prof_lock:
+            pr, self._profile = self._profile, None
+        if pr is None:
+            return
+        try:
+            pr["profiler"].close()  # idempotent; stops an open trace
+        except Exception as e:
+            error = error or e
+        if error is None and pr["future"].done():
+            # the watchdog/abandon path already failed this waiter
+            # while the window was wedged; the wedged dispatch then
+            # returned and the loop closed the window normally.  The
+            # wall is stall-inflated and no client will read it —
+            # discard it rather than adopt it as the "capture"-sourced
+            # ground truth behind /healthz and the roofline gauges.
+            error = RuntimeError(
+                "capture discarded: its waiter was already failed "
+                "(watchdog stall verdict stands)"
+            )
+        attr = None
+        if error is None:
+            try:
+                with self.recorder.span(
+                    "profile_attribute", track="engine.loop",
+                    dispatches=pr.get("resolved"),
+                ):
+                    attr = self._attribute_capture(pr)
+            except Exception as e:
+                error = e
+        if pr.get("owns_dir"):
+            import shutil
+
+            shutil.rmtree(pr["dir"], ignore_errors=True)
+        if error is not None:
+            self.recorder.instant(
+                "profile_error", track="engine.loop",
+                error=f"{type(error).__name__}: {error}",
+            )
+            _fail_future(pr["future"], error)
+            return
+        self._last_attr = attr
+        self._stats["profile_captures"] += 1
+        per = attr.get("device_time_ms_per_dispatch")
+        if per is not None:
+            self._hist_device.observe(per)
+        _set_result(pr["future"], attr)
+
+    def _attribute_capture(self, pr: Dict[str, Any]) -> Dict[str, Any]:
+        """Parse the capture's xplane and split the window into device
+        compute vs host gap, per dispatch family.  Family device time
+        is a PROPORTIONAL split by dispatch count — exact for the
+        common single-family window, pro-rata for mixed ones (fused
+        chunks next to plain dispatches)."""
+        from mlcomp_tpu.obs import devprof
+
+        planes = devprof.load_xspace(devprof.find_xplane(pr["dir"]))
+        # wall ends at the last resolve's device fetch (t_last), not at
+        # t1: the loop may have blocked in the idle queue pump between
+        # the final resolve and _profile_tick, and that idle wait is
+        # neither dispatch cost nor bubble — without this an
+        # early-closed window inflates host_gap_ms by up to the pump
+        # block (~200 ms) and the phantom overhead becomes the
+        # capture-sourced "truth" behind /healthz and the gauges.
+        wall_ms = (pr.get("t_last") or pr["t1"]) - pr["t0"]
+        wall_ms *= 1e3
+        att = devprof.attribution(planes, wall_ms=wall_ms, top_kernels=20)
+        n = int(pr.get("resolved") or 0)
+        att["dispatches"] = n
+        att["requested_dispatches"] = pr["n"]
+        roof_ms = self._roofline_ms
+        att["roofline_ms_per_dispatch"] = round(roof_ms, 4)
+        dev, gap = att["device_time_ms"], att["host_gap_ms"]
+        if n:
+            per = dev / n
+            util = round(roof_ms / per, 4) if per > 0 else None
+            att["device_time_ms_per_dispatch"] = round(per, 4)
+            att["host_gap_ms_per_dispatch"] = round(gap / n, 4)
+            att["roofline_utilization"] = util
+            total = sum(pr["families"].values()) or 1
+            # per-family utilization only when it is EXACT (single-
+            # family window): under the pro-rata split every family's
+            # per-dispatch device time — hence util — would be the
+            # same number, which reads as a measurement but isn't.
+            # Mixed windows report null; the window-wide util above
+            # stays the measured figure.
+            fam_util = util if len(pr["families"]) == 1 else None
+            att["families"] = {
+                fam: {
+                    "dispatches": c,
+                    "device_time_ms": round(dev * c / total, 4),
+                    "host_gap_ms": round(gap * c / total, 4),
+                    "roofline_utilization": fam_util,
+                }
+                for fam, c in sorted(pr["families"].items())
+            }
+        else:
+            att["device_time_ms_per_dispatch"] = None
+            att["host_gap_ms_per_dispatch"] = None
+            att["roofline_utilization"] = None
+            att["families"] = {}
+        self._merge_device_track(planes, pr)
+        return att
+
+    def _merge_device_track(self, planes, pr: Dict[str, Any]) -> None:
+        """Fold the capture's device spans into the flight recorder as
+        the named ``engine.device`` track: ``GET /trace`` then renders
+        host issue/resolve spans ALIGNED above the device programs they
+        launched, making pipeline bubbles and admission stalls visually
+        attributable.  Alignment anchors the earliest device event at
+        the capture's start on the recorder clock (host and device
+        clocks share no epoch; the capture window is the common
+        reference, good to ~the start_trace latency)."""
+        from mlcomp_tpu.obs import devprof
+
+        spans, dropped = devprof.device_spans_us(planes)
+        if not spans or pr.get("t0") is None:
+            return
+        base_us = self.recorder.to_trace_us(pr["t0"])
+        for ts, dur, name in spans:
+            self.recorder.complete(
+                devprof.short_op(name), base_us + ts, dur,
+                track="engine.device",
+            )
+        self.recorder.instant(
+            "device_capture", track="engine.device",
+            dispatches=pr.get("resolved"), spans=len(spans),
+            dropped=dropped,
+        )
+
+    def _device_summary(self) -> Dict[str, Any]:
+        """The device/host split behind ``stats()["device"]`` and the
+        roofline gauges: the last capture's measured attribution when
+        one exists, else the cheap steady-state ESTIMATE —
+        ``dispatch_wall − known host costs``, where the known host cost
+        is the pipeline's measured hidden (host-work) ms per dispatch.
+        The estimate is honest only when the pipeline saturates (the
+        resolve wait is then device-bound); captures are ground truth."""
+        p = dict(self._pstats)
+        done = self._stats["dispatches"]
+        roof_ms = self._roofline_ms
+        ss = None
+        if done:
+            wall = (p["hidden_ms"] + p["wait_ms"]) / done
+            host = p["hidden_ms"] / done
+            dev_est = max(wall - host, 0.0)
+            ss = {
+                "dispatch_wall_ms": round(wall, 3),
+                "host_overhead_ms": round(host, 3),
+                "device_time_ms_est": round(dev_est, 3),
+                "roofline_utilization_est": (
+                    round(roof_ms / dev_est, 4) if dev_est > 0 else None
+                ),
+            }
+        cap = self._last_attr
+        per = host_ms = util = None
+        if cap is not None:
+            per = cap.get("device_time_ms_per_dispatch")
+            host_ms = cap.get("host_gap_ms_per_dispatch")
+            util = cap.get("roofline_utilization")
+            # stats()/healthz recur (the report proxy re-serializes
+            # every scrape): carry the capture's summary numbers, not
+            # its parse products (top-20 kernels, plane/lane
+            # inventory) — the full dict went to the /profile caller
+            cap = {
+                k: v for k, v in cap.items()
+                if k not in (
+                    "kernels", "planes", "device_lanes", "device_events"
+                )
+            }
+        if per is None and ss is not None:
+            per = ss["device_time_ms_est"]
+            host_ms = ss["host_overhead_ms"]
+            util = ss["roofline_utilization_est"]
+        return {
+            "hbm_gbps": self._hbm_gbps,
+            "roofline_bytes_per_dispatch": self._roofline_bytes,
+            "roofline_ms_per_dispatch": round(roof_ms, 4),
+            "device_time_ms_per_dispatch": per,
+            "host_overhead_ms_per_dispatch": host_ms,
+            "roofline_utilization": util,
+            "source": (
+                "capture" if cap is not None
+                else "estimate" if ss is not None else None
+            ),
+            "captures": self._stats["profile_captures"],
+            "steady_state": ss,
+            "last_capture": cap,
+        }
+
     def _complete_admission(self) -> None:
         """Final admission boundary — the ONE synchronous stall the
         fused path keeps: queue the prefix-cache capture, insert the
@@ -1915,6 +2371,14 @@ class DecodeEngine:
                     )
         finally:
             self._busy_since = None
+        pr = self._profile
+        if pr is not None and pr["profiler"].active:
+            # capture-window accounting: which dispatch family this
+            # window's device time belongs to
+            fam = self._family_name(
+                fused[0].chunk if fused is not None else None
+            )
+            pr["families"][fam] = pr["families"].get(fam, 0) + 1
         self._inflight.append((packed, time.perf_counter(), seq))
         p = self._pstats
         p["issued"] += 1
@@ -1954,6 +2418,15 @@ class DecodeEngine:
         p = self._pstats
         p["hidden_ms"] += (t_block - t_issue) * 1e3
         p["wait_ms"] += (t_done - t_block) * 1e3
+        prc = self._profile
+        if prc is not None and prc["profiler"].active:
+            # the np.asarray above is a REAL device->host fetch (the
+            # tunnel-safe barrier; block_until_ready returns early
+            # there): the device finished this dispatch NOW, so this
+            # stamp — not the later _profile_tick, which runs after
+            # boundary maintenance may have blocked in the idle queue
+            # pump — is where the capture window's wall ends
+            prc["t_last"] = t_done
         self.recorder.async_end("dispatch", seq, cat="disp")
         toks = arr[0].astype(np.int32)
         lps = arr[1]
@@ -2042,6 +2515,9 @@ class DecodeEngine:
             # rows' futures fail below, and blocking here on a possibly
             # wedged device would stall close()'s join
             self._inflight.clear()
+            # an armed/active capture dies with the loop: close the
+            # trace window, fail its future — never a dangling session
+            self._finish_profile(error=err)
             for i in range(self.slots):
                 self._finish(i, error=err)
             self._fail_admission(err)
@@ -2168,6 +2644,9 @@ class DecodeEngine:
                     and all(s is None for s in self._host)
                 )
                 self._boundary_maintenance(block_s=0.2 if idle else 0.0)
+                # on-demand device capture (GET /profile): start/stop
+                # the trace window at this boundary when one is armed
+                self._profile_tick()
                 if (self._adm is None and None in self._host
                         and self._pending):
                     # STAGED join drain only: fused admissions start
@@ -2328,6 +2807,14 @@ class DecodeEngine:
             if adm.req["stream"] is not None:
                 adm.req["stream"].put(None)
             _fail_future(adm.req["future"], err)
+        # an armed/active capture is a waiter too: fail its future in
+        # bounded time like every other (idempotent — if the wedged
+        # dispatch ever returns, the loop's _finish_profile resolves
+        # second and loses the race); trace/state cleanup stays
+        # loop-owned, consistent with the slot bookkeeping above
+        pr = self._profile
+        if pr is not None:
+            _fail_future(pr["future"], err)
         # _pending snapshot may race the unsticking loop's own drain
         # (deque mutated mid-iteration) — retry; whoever wins, both
         # sides fail futures idempotently with comparable errors

@@ -1,9 +1,13 @@
 """Observability: dependency-free metrics registry (Prometheus text
-exposition) and the serving flight recorder's metric glue.
+exposition) and the dependency-free xplane reader behind device-time
+attribution.
 
-``mlcomp_tpu.obs.metrics`` is the only module here; it is stdlib-only
-by design — the serving daemon and report server must be scrapeable
-without a prometheus_client install (the container bakes nothing in).
+Both modules are stdlib-only by design — the serving daemon and report
+server must be scrapeable without a prometheus_client install, and the
+device-profile path (``GET /profile``, ``obs.devprof``) must parse
+``jax.profiler`` xplane captures without a TensorFlow install (the
+container bakes nothing in).  ``devprof`` is imported lazily by its
+consumers, never here — the metrics hot path must not pay for it.
 """
 
 from mlcomp_tpu.obs.metrics import (  # noqa: F401

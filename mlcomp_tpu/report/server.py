@@ -708,6 +708,25 @@ class _Handler(BaseHTTPRequestHandler):
             gau("mlcomp_serving_pipeline_occupancy",
                 "Mean in-flight dispatch depth at issue",
                 pl.get("occupancy"))
+            # device-time attribution (engine /profile captures or the
+            # steady-state estimate), lifted so fleet dashboards can
+            # chart the device/host split and roofline utilization per
+            # daemon without scraping each one
+            dev = eng.get("device") or {}
+            gau("mlcomp_serving_device_time_ms_per_dispatch",
+                "Device-lane busy ms per dispatch at the daemon "
+                "(capture-sourced when one ran, else estimated)",
+                dev.get("device_time_ms_per_dispatch"))
+            gau("mlcomp_serving_host_overhead_ms_per_dispatch",
+                "Non-device ms per dispatch at the daemon",
+                dev.get("host_overhead_ms_per_dispatch"))
+            gau("mlcomp_serving_roofline_utilization",
+                "HBM-roofline dispatch time / measured device time at "
+                "the daemon",
+                dev.get("roofline_utilization"))
+            ctr("mlcomp_serving_profile_captures_total",
+                "Device-profile captures the daemon completed",
+                dev.get("captures"))
             # resilience state: health verdict, watchdog activity and
             # admission-control rejects, lifted from the same /healthz
             # payload so one scrape target alerts on a sick daemon

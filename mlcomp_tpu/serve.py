@@ -70,6 +70,17 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
         spans, in-flight dispatch async spans, prefill chunks,
         prefix-cache lookups/captures, per-request lifecycle spans
         (404 for batchers without a drive loop to record)
+    GET  /profile?dispatches=N -> arm a windowed jax.profiler capture
+        around the next N dispatch boundaries, parse the xplane with
+        the dependency-free reader (obs/devprof.py) and answer with
+        the device-time attribution JSON: device_time_ms, host_gap_ms,
+        kernel breakdown, per-dispatch-family roofline utilization.
+        The capture's device spans also merge into the flight
+        recorder, so a /trace fetch afterwards renders host spans
+        aligned above the actual device program spans.  Needs live
+        decode traffic to complete (the window is dispatch-gated).
+        (404 for batchers without a drive loop, matching /trace; 409
+        while another capture is armed or in flight)
 
 ``MLCOMP_TPU_SERVE_TOKEN`` (optional) demands ``Authorization: Bearer``
 on every route, mirroring the report server's auth.
@@ -812,6 +823,27 @@ class GenerationService:
             )
         return self.engine.recorder.export(last_ms=last_ms)
 
+    def profile(self, dispatches: int = 8) -> Future:
+        """Arm an on-demand device-profile capture (behind
+        GET /profile): resolves to the attribution JSON once the
+        engine's next ``dispatches`` dispatch boundaries have been
+        captured and parsed.  Raises for batchers without a drive loop
+        (HTTP 404, matching /trace) and ``ProfileBusy`` while another
+        capture is in flight (HTTP 409)."""
+        if self.engine is None:
+            raise ValueError(
+                "device profiling needs the continuous batcher; "
+                f"this service runs the {self.batcher} batcher"
+            )
+        return self.engine.profile(dispatches=dispatches)
+
+    def profile_cancel(self, fut: Future) -> bool:
+        """Best-effort disarm of a not-yet-started capture (the HTTP
+        timeout path)."""
+        if self.engine is None:
+            return False
+        return self.engine.profile_cancel(fut)
+
     def close(self) -> None:
         self._stop.set()
         if self.engine is not None:
@@ -1270,6 +1302,64 @@ def make_http_server(
                 except ValueError as e:
                     return self._json(
                         {"error": f"{type(e).__name__}: {e}"}, 400
+                    )
+            if route == "/profile":
+                from urllib.parse import parse_qs
+
+                from mlcomp_tpu.engine import ProfileBusy
+
+                if service.engine is None:
+                    # match /trace semantics: a JSON 404, not a bare one
+                    return self._json(
+                        {"error": "device profiling needs the "
+                         "continuous batcher; this service runs the "
+                         f"{service.batcher} batcher"}, 404,
+                    )
+                try:
+                    qs = parse_qs(query)
+                    n = 8
+                    if qs.get("dispatches"):
+                        n = int(qs["dispatches"][0])
+                    # tighter than the engine's own [1, 1024] cap: the
+                    # close-of-window parse runs on the drive loop, so
+                    # an HTTP caller gets a proportionate window only
+                    if not 1 <= n <= 256:
+                        raise ValueError(
+                            f"dispatches must be in [1, 256], got {n}"
+                        )
+                except (ValueError, TypeError) as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 400
+                    )
+                try:
+                    fut = service.profile(dispatches=n)
+                except ProfileBusy as e:
+                    return self._json(
+                        {"error": str(e), "status": e.status}, 409,
+                    )
+                except Exception as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 500
+                    )
+                try:
+                    # the capture is dispatch-gated: it needs live
+                    # decode traffic to complete.  Same grace the
+                    # generate path gives a wedged engine.
+                    return self._json(
+                        fut.result(
+                            timeout=service.request_timeout_s + 30.0
+                        )
+                    )
+                except FutTimeout:
+                    service.profile_cancel(fut)
+                    return self._json(
+                        {"error": "capture did not complete (no decode "
+                         "traffic inside the window?)",
+                         "status": "profile_timeout"}, 504,
+                    )
+                except Exception as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 500
                     )
             if route == "/cache/stats":
                 stats = service.cache_stats()

@@ -14,6 +14,12 @@ Trainer config::
 A short window a few steps in is the TPU idiom: step 0 pays compilation,
 steps 1–2 warm caches; profiling [5, 8) records steady state without
 drowning the trace in warmup noise.
+
+The same windowed idiom drives the serving engine's on-demand capture
+(``DecodeEngine.profile`` / ``GET /profile?dispatches=N``): the drive
+loop feeds :meth:`step` the count of dispatches resolved since the
+capture armed, so the trace opens at the first profiled dispatch and
+closes — behind a real device barrier — after exactly N of them.
 """
 
 from __future__ import annotations
@@ -37,6 +43,17 @@ class StepProfiler:
         self.stop_step = self.start_step + int(num_steps)
         self._active = False
         self._done = False
+
+    @property
+    def active(self) -> bool:
+        """True while a trace window is open (started, not yet stopped)."""
+        return self._active
+
+    @property
+    def done(self) -> bool:
+        """True once the window has closed for good (stop or close);
+        a done profiler never starts another trace."""
+        return self._done
 
     def step(self, global_step: int, pending=None) -> None:
         """``pending``: arrays (e.g. the train state) to block on before a

@@ -123,6 +123,25 @@ class Tracer:
             track,
         )
 
+    def to_trace_us(self, t_perf: float) -> float:
+        """Map a ``time.perf_counter()`` reading onto this recorder's
+        timeline (µs since construction) — how externally-timestamped
+        spans (a parsed device capture) align with the live host spans."""
+        return (t_perf - self._t0) * 1e6
+
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 track: Optional[str] = None, **args) -> None:
+        """Record a complete ("X") span at an EXPLICIT timestamp — the
+        merge path for events that did not happen on this thread's
+        clock (device program spans parsed out of an xplane capture
+        land on their named track aligned with the host spans that
+        issued them)."""
+        self._append(
+            {"name": name, "ph": "X", "ts": float(ts_us),
+             "dur": float(dur_us), "args": args},
+            track,
+        )
+
     def counter(self, name: str, values: Dict[str, float]) -> None:
         """Counter track (e.g. loss over time) rendered as a graph."""
         self._append(
@@ -244,6 +263,10 @@ class _NullTracer(Tracer):
 
     def instant(self, name: str, track: Optional[str] = None,
                 **args) -> None:
+        pass
+
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 track: Optional[str] = None, **args) -> None:
         pass
 
     def counter(self, name: str, values: Dict[str, float]) -> None:

@@ -132,6 +132,56 @@ def test_step_profiler_writes_trace(tmp_path):
     assert any(p.is_file() for p in produced)  # a trace landed on disk
 
 
+def test_step_profiler_close_mid_window(tmp_path):
+    """close() while the window is OPEN (epoch ended mid-capture, the
+    engine's /profile teardown): the trace must stop cleanly, land on
+    disk, and the profiler must be permanently done — a later step()
+    inside what was the window must never reopen a trace (a dangling
+    jax.profiler session would break every later capture in the
+    process)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlcomp_tpu.utils.profile import StepProfiler
+
+    prof = StepProfiler(str(tmp_path / "prof"), start_step=1, num_steps=10)
+    f = jax.jit(lambda x: x * 2 + 1)
+    prof.step(0)
+    assert not prof.active
+    prof.step(1)  # opens the window (1 <= 1 < 11)
+    assert prof.active and not prof.done
+    f(jnp.ones((8, 8))).block_until_ready()
+    prof.close()  # mid-window: steps 2..10 never ran
+    assert not prof.active and prof.done
+    produced = list((tmp_path / "prof").rglob("*"))
+    assert any(p.is_file() for p in produced)  # the partial trace landed
+    # still inside the configured window — must NOT restart
+    prof.step(2)
+    assert not prof.active and prof.done
+    prof.close()  # idempotent
+    assert prof.done
+
+
+def test_step_profiler_resume_past_window(tmp_path):
+    """A restored trainer whose step counter is already past the window
+    must never start a trace (the resume-safety contract in the class
+    docstring — only the happy path was covered before)."""
+    from mlcomp_tpu.utils.profile import StepProfiler
+
+    prof = StepProfiler(str(tmp_path / "prof"), start_step=2, num_steps=3)
+    for step in (7, 8, 9):  # resumed past stop_step = 5
+        prof.step(step)
+        assert not prof.active and not prof.done
+    prof.flush()   # stop-only boundary on a never-started window
+    assert not prof.active
+    prof.close()
+    # no trace directory contents were ever produced
+    trace_dir = tmp_path / "prof"
+    assert not trace_dir.exists() or not any(
+        p.is_file() for p in trace_dir.rglob("*")
+    )
+
+
 def test_trainer_profile_config(tmp_path):
     from mlcomp_tpu.train.loop import Trainer
 

@@ -572,3 +572,143 @@ def test_window_batcher_starvation_stream_end_to_end():
     finally:
         svc.close()
     assert done_order.index("victim") < len(done_order) - 1, done_order
+
+
+# ------------------------------------------------- device-profile capture
+
+
+def _ephemeral_server(svc):
+    from mlcomp_tpu.serve import make_http_server
+
+    httpd = make_http_server(svc, "127.0.0.1", 0, "tiny")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_profile_404_on_window_batcher():
+    """GET /profile matches /trace semantics on a batcher without a
+    drive loop: a 404 with a JSON error body, not a bare 404."""
+    _, svc = _service(batcher="window")
+    httpd, base = _ephemeral_server(svc)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/profile", timeout=30)
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read())
+        assert "continuous batcher" in body["error"]
+        # /trace answers the same way — the two contracts stay aligned
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/trace", timeout=30)
+        assert ei.value.code == 404
+        assert "continuous batcher" in json.loads(ei.value.read())["error"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()
+
+
+def test_profile_bad_dispatches_400():
+    _, svc = _service(batcher="continuous")
+    httpd, base = _ephemeral_server(svc)
+    try:
+        for bad in ("0", "-3", "nope", "99999"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/profile?dispatches={bad}", timeout=30
+                )
+            assert ei.value.code == 400, bad
+            assert "error" in json.loads(ei.value.read())
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()
+
+
+def test_profile_conflict_409_then_completes():
+    """A second capture request while one is armed answers 409; the
+    armed capture then completes once decode traffic flows and returns
+    the attribution JSON over plain HTTP."""
+    _, svc = _service(batcher="continuous")
+    httpd, base = _ephemeral_server(svc)
+    try:
+        result = {}
+
+        def arm():
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/profile?dispatches=1", timeout=120
+                ) as r:
+                    result["code"] = r.status
+                    result["body"] = json.loads(r.read())
+            except Exception as e:  # surfaced by the main thread
+                result["error"] = repr(e)
+
+        th = threading.Thread(target=arm, daemon=True)
+        th.start()
+        # wait until the engine really holds the armed capture (the
+        # HTTP thread needs a moment to reach the engine)
+        for _ in range(200):
+            if svc.engine._profile is not None:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("capture never armed")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/profile", timeout=30)
+        assert ei.value.code == 409
+        body = json.loads(ei.value.read())
+        assert body["status"] == "profile_busy"
+
+        # traffic completes the window
+        gen = json.dumps({"prompt": [3, 4, 5], "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            f"{base}/generate", data=gen,
+            headers={"Content-Type": "application/json"},
+        )
+        deadline = time.time() + 120
+        while th.is_alive() and time.time() < deadline:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                json.loads(r.read())
+        th.join(timeout=30)
+        assert result.get("code") == 200, result
+        att = result["body"]
+        assert att["dispatches"] >= 1
+        assert att["device_time_ms"] > 0
+        assert att["host_gap_ms"] >= 0
+        assert att["kernels"] and att["families"]
+        # a capture happened: stats flips to capture-sourced attribution
+        dev = svc.engine.stats()["device"]
+        assert dev["source"] == "capture"
+        assert dev["captures"] == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()
+
+
+def test_profile_cancel_disarms_unstarted_capture():
+    """The HTTP timeout path: an armed-but-never-started capture (no
+    traffic) can be disarmed, failing its future, and a new capture can
+    arm afterwards."""
+    _, svc = _service(batcher="continuous")
+    try:
+        fut = svc.profile(dispatches=4)
+        assert svc.engine._profile is not None
+        assert svc.profile_cancel(fut)
+        assert svc.engine._profile is None
+        with pytest.raises(RuntimeError, match="cancelled"):
+            fut.result(timeout=5)
+        fut2 = svc.profile(dispatches=4)  # slot is free again
+        assert svc.engine.profile_cancel(fut2)
+    finally:
+        svc.close()
+
+
+def test_profile_future_fails_on_close():
+    """close() with a capture armed must fail the waiter, not strand
+    it."""
+    _, svc = _service(batcher="continuous")
+    fut = svc.profile(dispatches=2)
+    svc.close()
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=10)

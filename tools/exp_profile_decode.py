@@ -46,30 +46,22 @@ with jax.profiler.trace(trace_dir):
 
 pb = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
 print("xplane files:", pb, flush=True)
-from tensorflow.tsl.profiler.protobuf import xplane_pb2
+# dependency-free reader (mlcomp_tpu/obs/devprof.py) — no TF install
+# needed; same wire truth the tensorflow.tsl protobufs decoded
+from mlcomp_tpu.obs.devprof import load_xspace, short_op as short
 
-space = xplane_pb2.XSpace()
-with open(pb[0], "rb") as f:
-    space.ParseFromString(f.read())
-
-def short(nm):
-    # "%opname.123 = type stuff" -> opname stripped of trailing index
-    head = nm.split(" = ")[0].lstrip("%")
-    base = head.rsplit(".", 1)[0]
-    return base
-
-
-for plane in space.planes:
+for plane in load_xspace(pb[0]):
     if "TPU" not in plane.name and "tpu" not in plane.name:
         continue
     print(f"\n=== plane: {plane.name} ===")
-    ev_names = {i: m.name for i, m in plane.event_metadata.items()}
     for line in plane.lines:
         if line.name != "XLA Ops":
             continue
         # locate the token-loop while span; aggregate only events inside
-        wh = [ev for ev in line.events
-              if short(ev_names.get(ev.metadata_id, "?")) == "while"]
+        wh = [ev for ev in line.events if short(ev.name) == "while"]
+        if not wh:
+            print("no while span found")
+            continue
         wh = max(wh, key=lambda e: e.duration_ps)
         lo, hi = wh.offset_ps, wh.offset_ps + wh.duration_ps
         print(f"while span: {wh.duration_ps/1e9:.2f} ms "
@@ -77,13 +69,12 @@ for plane in space.planes:
         total = collections.Counter()
         counts = collections.Counter()
         for ev in line.events:
-            nm = ev_names.get(ev.metadata_id, "?")
-            if nm == ev_names.get(wh.metadata_id):
+            if ev.name == wh.name:
                 continue
             if not (lo <= ev.offset_ps and ev.offset_ps < hi):
                 continue
-            total[short(nm)] += ev.duration_ps / 1e6  # us
-            counts[short(nm)] += 1
+            total[short(ev.name)] += ev.duration_ps / 1e6  # us
+            counts[short(ev.name)] += 1
         grand = sum(total.values())
         steps = N_NEW - 1
         print(f"in-while op total: {grand/1e3:.2f} ms "
@@ -94,7 +85,7 @@ for plane in space.planes:
         shp = collections.Counter()
         scount = collections.Counter()
         for ev in line.events:
-            nm = ev_names.get(ev.metadata_id, "?")
+            nm = ev.name
             key = short(nm)
             if key not in ("copy", "dynamic_update_slice", "broadcast_in_dim"):
                 continue

@@ -67,28 +67,18 @@ with jax.profiler.trace(trace_dir):
 
 pb = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
 print("xplane files:", pb, flush=True)
-from tensorflow.tsl.profiler.protobuf import xplane_pb2
+# dependency-free reader (mlcomp_tpu/obs/devprof.py) — no TF install
+# needed; same wire truth the tensorflow.tsl protobufs decoded
+from mlcomp_tpu.obs.devprof import load_xspace, short_op as short
 
-space = xplane_pb2.XSpace()
-with open(pb[0], "rb") as f:
-    space.ParseFromString(f.read())
-
-
-def short(nm):
-    head = nm.split(" = ")[0].lstrip("%")
-    return head.rsplit(".", 1)[0]
-
-
-for plane in space.planes:
+for plane in load_xspace(pb[0]):
     if "TPU" not in plane.name and "tpu" not in plane.name:
         continue
     print(f"\n=== plane: {plane.name} ===")
-    ev_names = {i: m.name for i, m in plane.event_metadata.items()}
     for line in plane.lines:
         if line.name != "XLA Ops":
             continue
-        wh = [ev for ev in line.events
-              if short(ev_names.get(ev.metadata_id, "?")) == "while"]
+        wh = [ev for ev in line.events if short(ev.name) == "while"]
         if not wh:
             print("no while span found")
             continue
@@ -99,13 +89,12 @@ for plane in space.planes:
         total = collections.Counter()
         counts = collections.Counter()
         for ev in line.events:
-            nm = ev_names.get(ev.metadata_id, "?")
-            if nm == ev_names.get(wh.metadata_id):
+            if ev.name == wh.name:
                 continue
             if not (lo <= ev.offset_ps < hi):
                 continue
-            total[short(nm)] += ev.duration_ps / 1e6  # us
-            counts[short(nm)] += 1
+            total[short(ev.name)] += ev.duration_ps / 1e6  # us
+            counts[short(ev.name)] += 1
         grand = sum(total.values())
         print(f"in-scan op total: {grand/1e3:.2f} ms "
               f"({grand/1e3/K:.3f} ms/step if no overlap)")

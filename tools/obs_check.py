@@ -18,7 +18,12 @@ observability contract the docs promise (docs/observability.md):
 - ``GET /trace`` returns Chrome trace-event JSON (Perfetto-loadable):
   dispatch async begin/end pairs balance, issue/resolve spans exist,
   request lifecycle spans carry matched begin/ends, and ``last_ms``
-  windowing returns a subset.
+  windowing returns a subset;
+- ``GET /profile?dispatches=N`` completes against live traffic and
+  returns the device-time attribution contract (device_time_ms,
+  host_gap_ms, kernel breakdown, per-family roofline utilization),
+  and the ``/trace`` fetched AFTER it carries the merged
+  ``engine.device`` track aligned with the dispatch spans.
 
 No TPU needed (CPU jax), finishes in seconds; tests/test_obs_check.py
 wires it into tier-1 like tools/cachecheck.py.  Standalone:
@@ -63,6 +68,11 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_engine_trace_events_dropped_total",
     "mlcomp_engine_ttft_ms",
     "mlcomp_engine_per_token_ms",
+    "mlcomp_engine_device_time_ms",
+    "mlcomp_engine_device_time_ms_per_dispatch",
+    "mlcomp_engine_host_overhead_ms_per_dispatch",
+    "mlcomp_engine_roofline_utilization",
+    "mlcomp_engine_profile_captures_total",
     "mlcomp_engine_healthy",
     "mlcomp_engine_deadline_exceeded_total",
     "mlcomp_engine_cancelled_total",
@@ -223,6 +233,50 @@ def run(n_requests: int = 4) -> dict:
             assert len(out["ids"]) == 4, out
         svc.prefix_cache.flush()
 
+        # device-profile capture BEFORE the first scrape: the capture
+        # feeds mlcomp_engine_device_time_ms and flips the roofline
+        # gauges to capture-sourced, so the documented-metric check
+        # below sees every family.  The window is dispatch-gated, so
+        # traffic must flow while the request waits — pump generates
+        # until it resolves.
+        prof_res: dict = {}
+
+        def _arm_profile():
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/profile?dispatches=2", timeout=300
+                ) as r:
+                    prof_res["code"] = r.status
+                    prof_res["body"] = json.loads(r.read())
+            except Exception as e:
+                prof_res["error"] = repr(e)
+
+        th = threading.Thread(target=_arm_profile, daemon=True)
+        th.start()
+        pumped = 0
+        while th.is_alive() and pumped < 64:
+            generate(shared + [50 + pumped])
+            pumped += 1
+        th.join(timeout=120)
+        assert prof_res.get("code") == 200, prof_res
+        att = prof_res["body"]
+        for key in ("dispatches", "device_time_ms", "host_gap_ms",
+                    "device_time_ms_per_dispatch", "kernels", "families",
+                    "roofline_ms_per_dispatch", "roofline_utilization"):
+            assert key in att, f"/profile missing {key!r}: {sorted(att)}"
+        assert att["dispatches"] >= 1
+        assert att["device_time_ms"] > 0
+        assert att["kernels"] and att["families"]
+        for fam in att["families"].values():
+            for key in ("dispatches", "device_time_ms", "host_gap_ms",
+                        "roofline_utilization"):
+                assert key in fam, fam
+        # one capture at a time: a second request while nothing is
+        # armed must NOT 409 (the slot freed) — but arming twice does.
+        # (the live 409 is covered by tests/test_serve.py; here we just
+        # assert the slot is free again)
+        assert svc.engine._profile is None
+
         text1 = get("/metrics").decode()
         s1, t1 = parse_exposition(text1)
         check_histograms(s1, t1)
@@ -259,6 +313,34 @@ def run(n_requests: int = 4) -> dict:
         for want in ("issue", "resolve", "request", "first_token",
                      "prefill_chunk", "insert", "prefix_cache.lookup"):
             assert want in names, f"missing trace span {want!r}"
+        # the /profile capture merged a DEVICE track: a named
+        # engine.device thread whose complete spans sit inside the
+        # capture window — host spans render aligned above them
+        track_tids = {
+            e["args"]["name"]: e["tid"] for e in evs
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert "engine.device" in track_tids, sorted(track_tids)
+        dev_evs = [
+            e for e in evs
+            if e.get("tid") == track_tids["engine.device"]
+            and e["ph"] == "X"
+        ]
+        assert dev_evs, "device track carries no spans"
+        for e in dev_evs:
+            assert e.get("dur", 0) >= 0 and "ts" in e, e
+        # alignment: the device spans overlap the host dispatch span
+        # range (both sit on the recorder clock)
+        disp_ts = [
+            e["ts"] for e in evs
+            if e.get("cat") == "disp" and e["ph"] in ("b", "e")
+        ]
+        dev_lo = min(e["ts"] for e in dev_evs)
+        dev_hi = max(e["ts"] + e.get("dur", 0) for e in dev_evs)
+        assert disp_ts and dev_lo <= max(disp_ts) and (
+            dev_hi >= min(disp_ts)
+        ), "device track does not overlap the dispatch spans"
+        assert "device_capture" in names
         # last_ms windows: a zero-width trailing window drops the
         # decode-time events the full fetch carried
         tiny = json.loads(get("/trace?last_ms=0.001"))
@@ -268,6 +350,9 @@ def run(n_requests: int = 4) -> dict:
             "metric_families": len(t2),
             "trace_events": len(evs),
             "dispatch_spans": begins,
+            "profile_dispatches": int(att["dispatches"]),
+            "device_track_spans": len(dev_evs),
+            "device_time_ms": att["device_time_ms"],
         }
     finally:
         httpd.shutdown()
