@@ -369,6 +369,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         max_queue_depth=args.max_queue_depth,
         max_concurrent_requests=args.max_concurrent_requests,
         dispatch_stall_timeout=args.dispatch_stall_timeout or None,
+        kv_layout=args.kv_layout,
+        kv_page_tokens=args.kv_page_tokens,
+        kv_pages=args.kv_pages,
+        max_slots=args.max_slots,
     )
     if args.warmup:
         n = service.warmup()
@@ -648,6 +652,38 @@ def main(argv=None) -> int:
         " a joiner prefills one chunk per dispatch boundary (fused"
         " into the decode dispatch by default); all-pad chunks are"
         " skipped",
+    )
+    sv.add_argument(
+        "--kv-layout", default="dense", choices=("dense", "paged"),
+        help="continuous batcher: device KV layout. 'paged' stores KV"
+        " as fixed-size pages gathered through per-slot page tables"
+        " (mlcomp_tpu/kvpool): sequence length is paid per page,"
+        " admission is gated by FREE PAGES instead of worst-case slot"
+        " reservations (429 reason no_free_pages), the slot count"
+        " scales elastically up to --max-slots, and same-placement"
+        " shared prompt prefixes map the same physical pages"
+        " copy-on-write.  Outputs are bit-identical to 'dense' (the"
+        " default and the bisect mode); single-chip for now",
+    )
+    sv.add_argument(
+        "--kv-page-tokens", type=int, default=None,
+        help="paged KV: tokens per page (default: the gcd of the"
+        " buckets' prefill chunk widths, so chunk-aligned prefix"
+        " boundaries land on page boundaries; must divide every"
+        " bucket's chunk width)",
+    )
+    sv.add_argument(
+        "--kv-pages", type=int, default=None,
+        help="paged KV: total physical pages incl. the 2 reserved"
+        " (default: the dense layout's KV bytes — equal HBM, paid per"
+        " page, so mixed-length traffic fits more streams)",
+    )
+    sv.add_argument(
+        "--max-slots", type=int, default=None,
+        help="paged KV: elastic slot-count cap (default 4x the largest"
+        " --batch-sizes entry); the live count grows under queued"
+        " traffic when the page budget allows and shrinks back at"
+        " quiesce",
     )
     sv.add_argument(
         "--kv-quant", action="store_true",

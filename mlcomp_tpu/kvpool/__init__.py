@@ -1,0 +1,32 @@
+"""Device-resident paged KV cache for the serving engine.
+
+The dense engine pays worst-case KV per slot: every row owns a full
+``(l_buf, heads, dim)`` stripe whatever its real length, and the slot
+count is fixed at construction — concurrency caps long before HBM
+does.  This package stores the KV buffer as ``(num_pages, page_tokens,
+...)`` blocks instead, with per-slot page tables, so sequence length
+is paid per page, left-pad and unused budget cost nothing (the shared
+NULL page), prefix-cache hits map shared pages copy-on-write, and the
+active slot count scales with live traffic under a free-page budget.
+
+- ``allocator``: host free-list + ref-count bookkeeping (reserved
+  NULL/GRAVE pages, COW-fork accounting);
+- ``layout``: traced gather/scatter between pages and the dense view
+  the decode programs consume (``jnp.take`` lax fallback everywhere,
+  scalar-prefetch Pallas DMA gather on TPU) — bit-equality with the
+  dense layout by construction;
+- ``pool``: slot-row policy, the device prefix-page registry, stats.
+
+``mlcomp_tpu/engine.py`` wires it in behind ``kv_layout="paged"``;
+``docs/serving.md`` ("Paged KV") documents the policies.
+"""
+
+from mlcomp_tpu.kvpool.allocator import (  # noqa: F401
+    GRAVE_PAGE,
+    NULL_PAGE,
+    RESERVED_PAGES,
+    NoFreePages,
+    PageAllocator,
+)
+from mlcomp_tpu.kvpool.layout import PagedLayout  # noqa: F401
+from mlcomp_tpu.kvpool.pool import PageLease, PagePool  # noqa: F401

@@ -1,0 +1,382 @@
+"""Paged layout over the engine's KV cache pytree: TRACED gather and
+scatter between ``(num_pages, page_tokens, ...)`` page arrays and the
+dense ``(slots, l_buf, ...)`` view the decode programs consume.
+
+The design constraint is BIT-EQUALITY with the dense layout: the paged
+dispatch gathers the dense view through the slot page tables, runs the
+UNCHANGED dispatch core on it, and scatters the updated view back —
+the decode math never sees a different buffer, so paged outputs equal
+dense outputs by construction (enforced again by test).  Gather and
+scatter are pure data movement (transpose/reshape/take/scatter — no
+arithmetic), so the round trip is exact for every dtype the cache
+families use (f32/bf16 K/V, int8 kv8 blocks, bf16 scales).
+
+Layout rules, shared with the host prefix cache
+(``cache/kv_store.SLOT_AXES``): every KV leaf has a batch (slot) axis 0
+and a sequence (cache-slot) axis; its page array replaces axis 0 with
+the physical-page axis and the sequence axis with ``page_tokens``.
+Non-KV leaves (``cache_index`` scalars) are slot-count-independent and
+ride the paged carry untouched.
+
+The gather has two implementations:
+
+- ``lax``: ``jnp.take`` over the page axis — runs everywhere, the
+  correctness reference (CPU tests run this path);
+- ``pallas``: a scalar-prefetch DMA copy kernel
+  (``PrefetchScalarGridSpec``; the page table is prefetched so each
+  grid step's block index comes straight from it) — one HBM pass with
+  no intermediate (slots*max_pages, ...) index materialization.  TPU
+  only; ``impl="auto"`` picks it there and falls back to ``lax``
+  elsewhere.  This is the gather the decode kernels read through; a
+  fully fused paged-attention kernel (no dense view at all) is the
+  open follow-up once the engine's attention paths take page tables
+  directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+
+class LeafSpec(NamedTuple):
+    keystr: str
+    slot_axis: Optional[int]   # None: non-KV leaf (cache_index scalar)
+    shape: Tuple[int, ...]     # dense leaf shape at slots=1
+    dtype: Any
+    seq_len: int               # the leaf's OWN buffer length: the kv8
+    # family lane-rounds L past the engine's l_buf (pick_buffer_len);
+    # the rounded tail is never written non-zero, so its pages stay
+    # NULL — but gather/scatter must cover it to rebuild exact shapes
+
+
+class PagedLayout:
+    """Static description of one engine cache family's paged form.
+
+    Built once from an ABSTRACT ``init_cache(model, 1, l_buf)`` pytree
+    (shapes only — nothing materializes); every traced gather/scatter
+    closes over it, so the treedef and per-leaf axes never ride the
+    program arguments.
+    """
+
+    def __init__(self, cache, l_buf: int, page_tokens: int,
+                 num_pages: Optional[int] = None):
+        import jax
+
+        from mlcomp_tpu.cache.kv_store import SLOT_AXES, _leaf_name
+
+        self.l_buf = int(l_buf)
+        self.page_tokens = int(page_tokens)
+        # num_pages may stay unset while the caller derives the pool
+        # budget FROM the layout (max_pages is a function of the cache
+        # shapes alone) — anything that materializes or prices pages
+        # checks it via _require_pages
+        self.num_pages = None if num_pages is None else int(num_pages)
+        if self.page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1: {page_tokens}")
+        flat, self.treedef = jax.tree_util.tree_flatten_with_path(cache)
+        self.leaves: List[LeafSpec] = []
+        for path, leaf in flat:
+            name = _leaf_name(path)
+            keystr = "/".join(_leaf_name((k,)) for k in path)
+            if name == "cache_index":
+                self.leaves.append(
+                    LeafSpec(keystr, None, tuple(leaf.shape), leaf.dtype,
+                             0)
+                )
+                continue
+            if name not in SLOT_AXES:
+                raise ValueError(
+                    f"unknown cache leaf {name!r}: teach "
+                    "cache/kv_store.py its slot axis before paging "
+                    "this layout"
+                )
+            ax = SLOT_AXES[name]
+            if leaf.shape[ax] < self.l_buf:
+                raise ValueError(
+                    f"leaf {keystr} has {leaf.shape[ax]} cache slots at "
+                    f"axis {ax}, below l_buf={self.l_buf}"
+                )
+            self.leaves.append(
+                LeafSpec(keystr, ax, tuple(leaf.shape), leaf.dtype,
+                         int(leaf.shape[ax]))
+            )
+        self.kv_specs = [s for s in self.leaves if s.slot_axis is not None]
+        # table width: enough pages to cover the LONGEST leaf buffer
+        # (the kv8 family lane-rounds past l_buf); each leaf gathers
+        # through only its own first ceil(seq_len/T) table columns, and
+        # pages past a slot's token span are NULL, so the rounded tail
+        # costs table entries, never pages
+        self.max_pages = max(
+            -(-s.seq_len // self.page_tokens) for s in self.kv_specs
+        )
+
+    # ---------------------------------------------------------- allocation
+
+    def _require_pages(self) -> int:
+        if self.num_pages is None:
+            raise ValueError(
+                "PagedLayout.num_pages is unset: set it (or pass it at "
+                "construction) before materializing or pricing pages"
+            )
+        return self.num_pages
+
+    def page_shape(self, spec: LeafSpec) -> Tuple[int, ...]:
+        # the page axis replaces the slot batch axis, with the sequence
+        # axis next to it so a page is one contiguous (T, rest) tile
+        return tuple(
+            [self._require_pages(), self.page_tokens]
+            + [d for i, d in enumerate(spec.shape)
+               if i not in (0, spec.slot_axis)]
+        )
+
+    def fresh_pages(self) -> List[Any]:
+        """Zeroed device page arrays, one per KV leaf (kv order)."""
+        import jax.numpy as jnp
+
+        return [
+            jnp.zeros(self.page_shape(s), s.dtype) for s in self.kv_specs
+        ]
+
+    def page_bytes(self) -> int:
+        """Bytes of ONE page across every KV leaf — the allocation
+        quantum admission control budgets in.  Independent of
+        num_pages, so the caller can size the pool FROM it."""
+        import numpy as np
+
+        total = 0
+        for s in self.kv_specs:
+            rest = [d for i, d in enumerate(s.shape)
+                    if i not in (0, s.slot_axis)]
+            total += (
+                self.page_tokens * int(np.prod(rest, dtype=np.int64))
+                * np.dtype(s.dtype).itemsize
+            )
+        return total
+
+    def bytes_total(self) -> int:
+        return self.page_bytes() * self._require_pages()
+
+    # ------------------------------------------------------------- tracing
+
+    def _rest_axes(self, spec: LeafSpec) -> List[int]:
+        return [
+            i for i in range(len(spec.shape))
+            if i not in (0, spec.slot_axis)
+        ]
+
+    def _dense_order(self, spec: LeafSpec) -> List[int]:
+        """Axes argument mapping canonical (S, seq, rest...) back to
+        the dense leaf layout: dense axis i reads canonical axis
+        order[i]."""
+        order = [0] * len(spec.shape)
+        order[0] = 0
+        order[spec.slot_axis] = 1
+        for j, i in enumerate(self._rest_axes(spec)):
+            order[i] = 2 + j
+        return order
+
+    def _to_view(self, spec: LeafSpec, rows):
+        """(S, MP*T, rest...) canonical rows -> dense leaf layout.
+        Sliced to the LEAF's own buffer length: the kv8 family
+        lane-rounds past l_buf, and each leaf rebuilds exactly the
+        shape the model allocated."""
+        import jax.numpy as jnp
+
+        rows = rows[:, : spec.seq_len]
+        return jnp.transpose(rows, axes=self._dense_order(spec))
+
+    def _from_view(self, spec: LeafSpec, leaf):
+        """Dense leaf -> (S, MP*T, rest...) canonical rows, zero-padded
+        from the leaf's seq_len up to MP*T (the pad lands beyond every
+        slot's span, on pages whose gathered content was zero — see
+        scatter)."""
+        import jax.numpy as jnp
+
+        perm = [0, spec.slot_axis] + self._rest_axes(spec)
+        rows = jnp.transpose(leaf, axes=perm)
+        pad = self.max_pages * self.page_tokens - spec.seq_len
+        if pad:
+            rows = jnp.pad(rows, [(0, 0), (0, pad)] + [(0, 0)] * (
+                rows.ndim - 2
+            ))
+        return rows
+
+    def gather(self, pages: Sequence[Any], table, scalars: Sequence[Any],
+               impl: str = "auto"):
+        """TRACED: rebuild the dense cache pytree from page arrays
+        through ``table`` (S, max_pages) int32.  ``scalars`` are the
+        non-KV leaves in layout order."""
+        import jax.numpy as jnp
+
+        views, ki, si = [], 0, 0
+        for spec in self.leaves:
+            if spec.slot_axis is None:
+                views.append(scalars[si])
+                si += 1
+                continue
+            pg = pages[ki]
+            ki += 1
+            # only this leaf's own columns: pages past ceil(seq_len/T)
+            # map NULL for every slot (the table is sized to the
+            # LONGEST leaf), so gathering them would move zeros the
+            # _to_view slice discards anyway
+            n_cols = -(-spec.seq_len // self.page_tokens)
+            rows = _gather_leaf(
+                pg, table[:, :n_cols], self.page_tokens, impl=impl
+            )  # (S, n_cols, T, rest...)
+            rows = rows.reshape(
+                (rows.shape[0], n_cols * self.page_tokens)
+                + rows.shape[3:]
+            )
+            views.append(self._to_view(spec, rows))
+        return self.treedef.unflatten(views)
+
+    def scatter(self, pages: Sequence[Any], table, cache) -> List[Any]:
+        """TRACED: write the dense view back through ``table``.  Every
+        mapped page receives the bytes the view holds for it; shared
+        pages get identical bytes from every mapper (decode never
+        writes below a slot's private span — the COW alloc policy in
+        pool.py guarantees it), NULL_PAGE gets back the zeros it
+        served, GRAVE_PAGE absorbs retired rows' frozen-cursor writes.
+        """
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(cache)
+        dense = [leaf for _, leaf in flat]
+        out, ki = [], 0
+        S = table.shape[0]
+        flat_tbl = table.reshape((S * self.max_pages,))
+        for spec, leaf in zip(self.leaves, dense):
+            if spec.slot_axis is None:
+                continue
+            rows = self._from_view(spec, leaf)
+            rows = rows.reshape(
+                (S * self.max_pages, self.page_tokens) + rows.shape[2:]
+            )
+            out.append(pages[ki].at[flat_tbl].set(rows))
+            ki += 1
+        return out
+
+    def scalars_of(self, cache) -> List[Any]:
+        """The non-KV leaves of a dense cache pytree, layout order."""
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(cache)
+        return [
+            leaf for (path, leaf), spec in zip(flat, self.leaves)
+            if spec.slot_axis is None
+        ]
+
+    def insert_rows(self, pages: Sequence[Any], write_sel,
+                    cache) -> List[Any]:
+        """TRACED: write ONE prefilled ``(1, ...)`` dense admission
+        cache into the page arrays.  ``write_sel`` is the slot's
+        (max_pages,) int32 write ROUTING: the private page id where the
+        insert must materialize the row's bytes, ``GRAVE_PAGE``
+        everywhere else — shared prefix pages keep their bytes (the
+        copy-on-write mapping: the admission recomputed identical
+        bytes, and routing them to the graveyard is what makes the
+        shared page a zero-copy reference), and NULL stays untouched.
+        Duplicate GRAVE targets are fine: the graveyard's content is
+        never read."""
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(cache)
+        dense = [leaf for _, leaf in flat]
+        out, ki = [], 0
+        for spec, leaf in zip(self.leaves, dense):
+            if spec.slot_axis is None:
+                continue
+            rows = self._from_view(spec, leaf)
+            rows = rows.reshape(
+                (self.max_pages, self.page_tokens) + rows.shape[2:]
+            )
+            out.append(pages[ki].at[write_sel].set(rows))
+            ki += 1
+        return out
+
+    def gather_row_span(self, pages: Sequence[Any], page_ids,
+                        width: int) -> List[Any]:
+        """TRACED: slot rows [0, width) of every KV leaf as ONE (1,...)
+        row set (``cache/kv_store.write_slot_rows`` order) gathered
+        from ``page_ids`` (the span's table entries, device int32) —
+        the device-to-device half of a prefix-registry hit: no host
+        round-trip, the persistent pages stay shared."""
+        import jax.numpy as jnp
+
+        n_pages = -(-width // self.page_tokens)
+        out = []
+        for spec, pg in zip(self.kv_specs, pages):
+            rows = pg[page_ids]  # (n_pages, T, rest...)
+            rows = rows.reshape(
+                (1, n_pages * self.page_tokens) + rows.shape[2:]
+            )[:, :width]
+            out.append(jnp.transpose(rows, axes=self._dense_order(spec)))
+        return out
+
+
+def _gather_leaf(pages, table, page_tokens: int, impl: str = "auto"):
+    """(P, T, rest...) pages + (S, MP) table -> (S, MP, T, rest...).
+
+    ``impl``: "lax" (jnp.take — everywhere), "pallas" (TPU DMA-copy
+    kernel), "auto" (pallas on TPU, else lax).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if impl == "auto":
+        try:
+            impl = (
+                "pallas"
+                if jax.devices()[0].platform == "tpu" else "lax"
+            )
+        except Exception:
+            impl = "lax"
+    if impl == "lax":
+        return jnp.take(pages, table, axis=0)
+    if impl != "pallas":
+        raise ValueError(f"impl must be auto/lax/pallas, got {impl!r}")
+    return _gather_leaf_pallas(pages, table)
+
+
+def _gather_leaf_pallas(pages, table, interpret: bool = False):
+    """Scalar-prefetch page gather: grid (S, MP); the prefetched table
+    drives each step's input block index, so block (s, p) DMA-copies
+    physical page ``table[s, p]`` into logical position (s, p) — one
+    HBM pass, no index arrays materialized.  Collapses the per-page
+    payload to 2D (T, R) so the same kernel serves every leaf family
+    (bf16 K/V, int8 kv8 blocks, bf16 scales)."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P, T = pages.shape[0], pages.shape[1]
+    rest = pages.shape[2:]
+    R = 1
+    for d in rest:
+        R *= d
+    S, MP = table.shape
+    pages2 = pages.reshape(P, T, R)
+
+    def copy_kernel(tbl_ref, page_ref, out_ref):
+        # blocks: page_ref (1, T, R) at physical page tbl[s, p],
+        # out_ref (1, 1, T, R) at logical (s, p) — a pure DMA copy
+        out_ref[0, 0] = page_ref[0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S, MP),
+        in_specs=[
+            pl.BlockSpec((1, T, R), lambda s, p, tbl: (tbl[s, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, T, R), lambda s, p, tbl: (s, p, 0, 0)
+        ),
+    )
+    out = pl.pallas_call(
+        copy_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, MP, T, R), pages.dtype),
+        interpret=interpret,
+    )(table, pages2)
+    return out.reshape((S, MP, T) + rest)

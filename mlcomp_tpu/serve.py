@@ -185,6 +185,10 @@ class GenerationService:
         max_queue_depth: int = 0,
         max_concurrent_requests: int = 0,
         dispatch_stall_timeout: Optional[float] = None,
+        kv_layout: str = "dense",
+        kv_page_tokens: Optional[int] = None,
+        kv_pages: Optional[int] = None,
+        max_slots: Optional[int] = None,
     ):
         import jax
 
@@ -289,7 +293,26 @@ class GenerationService:
             )
         self.max_queue_depth = int(max_queue_depth or 0)
         self.max_concurrent_requests = int(max_concurrent_requests or 0)
-        self._rejects = {"queue_full": 0, "concurrency": 0}
+        self._rejects = {
+            "queue_full": 0, "concurrency": 0, "no_free_pages": 0,
+        }
+        # paged device KV (mlcomp_tpu/kvpool): admission control gains
+        # the free-page budget as a first-class resource — a request
+        # whose worst-case page need exceeds what is free, reclaimable,
+        # and not already spoken for by the queued backlog fast-fails
+        # with 429 ``no_free_pages`` (always on for the paged layout:
+        # unlike the opt-in queue caps, pool exhaustion is a hard
+        # physical bound, and queueing past it is just a slower 429)
+        self.kv_layout = str(kv_layout)
+        if batcher not in ("auto", "continuous") and (
+            self.kv_layout != "dense" or kv_page_tokens is not None
+            or kv_pages is not None or max_slots is not None
+        ):
+            raise ValueError(
+                "kv_layout / kv_page_tokens / kv_pages / max_slots need "
+                "the continuous batcher (only the slot engine owns a "
+                "device KV pool)"
+            )
         # the scrape registry behind GET /metrics: the engine (and its
         # prefix cache) register collectors into it below; the service
         # contributes its own batcher counters — one exposition per
@@ -423,6 +446,10 @@ class GenerationService:
                 flight_recorder_events=flight_recorder_events,
                 metrics=self.metrics,
                 dispatch_stall_timeout=dispatch_stall_timeout,
+                kv_layout=kv_layout,
+                kv_page_tokens=kv_page_tokens,
+                kv_pages=kv_pages,
+                max_slots=max_slots,
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous
@@ -544,7 +571,7 @@ class GenerationService:
                     "batcher"
                 )
         if self.engine is not None:
-            self._admission_check()
+            self._admission_check(ids, n_new)
             # per-request deadlines may only TIGHTEN the operator's
             # --request-timeout budget: a slot is a shared resource,
             # so a client cannot extend its hold past the service cap
@@ -594,14 +621,7 @@ class GenerationService:
             return False
         return self.engine.cancel(rid)
 
-    def _retry_after_s(self) -> float:
-        """Drain estimate behind 429's ``Retry-After``: how long until
-        roughly one queue's worth of work clears, from the live
-        per-token latency — (waiting + active) requests × the mean
-        tokens each emits × p50 per-token ms, spread over the slot
-        pool.  Falls back to 1 s before any latency samples exist;
-        clamped to [1, 60] so a pathological estimate never tells
-        clients to go away for an hour."""
+    def _per_token_p50_ms(self) -> Optional[float]:
         eng = self.engine
         try:
             samples = list(eng._lat_tok)
@@ -610,8 +630,57 @@ class GenerationService:
             # exactly that load still needs SOME answer, not a 500
             samples = []
         if not samples:
+            return None
+        return float(np.median(np.asarray(samples)))
+
+    def _retry_after_s(self, needed_pages: Optional[int] = None) -> float:
+        """Drain estimate behind 429's ``Retry-After``.  Slot-pool
+        heuristic (dense): (waiting + active) requests × the mean
+        tokens each emits × p50 per-token ms, spread over the slot
+        pool.  PAGED (``needed_pages`` set): projected page-free rate
+        instead — walk the active slots soonest-retiring first,
+        accumulate the pages each will return (its table row's
+        non-reserved entries; shared pages are counted optimistically —
+        a lower bound on the wait beats an hour-long guess), and answer
+        the remaining-token clock of the slot whose retirement finally
+        covers the need.  Falls back to 1 s before any latency samples
+        exist; clamped to [1, 60] so a pathological estimate never
+        tells clients to go away for an hour."""
+        eng = self.engine
+        per_tok = self._per_token_p50_ms()
+        if per_tok is None:
             return 1.0
-        per_tok = float(np.median(np.asarray(samples)))
+        if needed_pages is not None and eng._pool is not None:
+            from mlcomp_tpu.kvpool import RESERVED_PAGES
+
+            try:
+                pool = eng._pool
+                freed = pool.alloc.free_pages + pool.reclaimable_pages()
+                rows = sorted(
+                    (sl.remaining, i)
+                    for i, sl in enumerate(list(eng._host))
+                    if sl is not None
+                )
+                eta_tokens = None
+                for remaining, i in rows:
+                    freed += int(
+                        (pool.tables[i] >= RESERVED_PAGES).sum()
+                    )
+                    if freed >= needed_pages:
+                        eta_tokens = remaining
+                        break
+                if eta_tokens is None:
+                    return 60.0
+                return float(
+                    min(max(eta_tokens * per_tok / 1e3, 1.0), 60.0)
+                )
+            except RuntimeError:
+                # loop thread resized a registry/table dict mid-walk
+                # (same torn-read race _page_budget_check and the
+                # engine's _pool_stats tolerate): fall back to the
+                # slot-pool heuristic below — a rough Retry-After
+                # still beats turning this 429 into a 500
+                pass
         st = eng._stats
         finished = max(1, eng._lat_ttft_n)
         mean_tokens = max(1.0, st["emitted_tokens"] / finished)
@@ -622,36 +691,79 @@ class GenerationService:
         )
         return float(min(max(eta, 1.0), 60.0))
 
-    def _admission_check(self) -> None:
-        """Bounded-queue / concurrency fast-fail (continuous engine).
-        Approximate by design — two racing submits may both pass a
-        cap-1 check — which is the standard admission-control trade:
-        the bound is 'about N', never a hung client."""
+    def _reject(self, reason: str, msg: str,
+                needed_pages: Optional[int] = None) -> None:
+        self._rejects[reason] += 1
+        self.engine.recorder.instant(
+            "reject", track="service", reason=reason,
+        )
+        raise BackpressureError(
+            msg, reason, self._retry_after_s(needed_pages=needed_pages)
+        )
+
+    def _page_budget_check(self, ids, n_new: int) -> None:
+        """Free-page admission gate (paged layout, always on): the
+        request's WORST-case page need against what is free plus
+        reclaimable minus the queued backlog's own worst-case needs —
+        pages commit only at insert, so without the backlog term a
+        flood would all pass the same free-page reading and queue
+        unboundedly.  Approximate like the other caps (racing submits
+        may both pass); the engine's own boundary gate defers or fails
+        whatever slips through."""
         eng = self.engine
+        try:
+            need = eng._pages_worst({"ids": ids, "n_new": n_new})
+            pool = eng._pool
+            avail = pool.alloc.free_pages + pool.reclaimable_pages()
+            backlog = 0
+            for r in list(eng._pending):
+                backlog += eng._pages_worst(r)
+            with eng._queue.mutex:
+                parked = [
+                    r for r in eng._queue.queue if isinstance(r, dict)
+                ]
+            for r in parked:
+                backlog += eng._pages_worst(r)
+            adm = eng._adm
+            if adm is not None:
+                backlog += eng._pages_worst(adm.req)
+        except RuntimeError:
+            return  # torn read mid-mutation: admit, the engine re-gates
+        if need <= avail - backlog:
+            return
+        self._reject(
+            "no_free_pages",
+            f"request needs {need} KV pages worst-case; "
+            f"{max(avail - backlog, 0)} free after the queued backlog "
+            f"(pool: {pool.alloc.total_pages})",
+            needed_pages=need + backlog,
+        )
+
+    def _admission_check(self, ids=None, n_new: Optional[int] = None):
+        """Admission fast-fail (continuous engine): the paged layout's
+        free-page budget first (the hard physical resource), then the
+        opt-in bounded queue / concurrency caps.  Approximate by design
+        — two racing submits may both pass a cap-1 check — which is the
+        standard admission-control trade: the bound is 'about N', never
+        a hung client."""
+        eng = self.engine
+        if eng._pool is not None and ids is not None:
+            self._page_budget_check(ids, int(n_new))
         if self.max_queue_depth <= 0 and self.max_concurrent_requests <= 0:
             return
         depth = eng._queue.qsize() + len(eng._pending)
-        reason = None
         if 0 < self.max_queue_depth <= depth:
-            reason = "queue_full"
-            msg = (
+            self._reject("queue_full", (
                 f"submit queue is full ({depth} >= max_queue_depth="
                 f"{self.max_queue_depth})"
-            )
-        else:
-            active = sum(1 for s in eng._host if s is not None)
-            inflight = depth + active + (1 if eng._adm is not None else 0)
-            if 0 < self.max_concurrent_requests <= inflight:
-                reason = "concurrency"
-                msg = (
-                    f"{inflight} requests in flight >= "
-                    f"max_concurrent_requests={self.max_concurrent_requests}"
-                )
-        if reason is None:
-            return
-        self._rejects[reason] += 1
-        eng.recorder.instant("reject", track="service", reason=reason)
-        raise BackpressureError(msg, reason, self._retry_after_s())
+            ))
+        active = sum(1 for s in eng._host if s is not None)
+        inflight = depth + active + (1 if eng._adm is not None else 0)
+        if 0 < self.max_concurrent_requests <= inflight:
+            self._reject("concurrency", (
+                f"{inflight} requests in flight >= "
+                f"max_concurrent_requests={self.max_concurrent_requests}"
+            ))
 
     def warmup(self) -> int:
         """Precompile the hot programs by RUNNING a dummy generation per
@@ -763,6 +875,12 @@ class GenerationService:
                 # the --engine-spec-k knob is a measured loss) without
                 # digging through the engine section
                 out["spec"] = eng["spec"]
+            if "kv_pool" in eng:
+                # paged-KV occupancy at the top level: /healthz readers
+                # (and the report proxy) see pages free/used and the
+                # live elastic slot count without digging
+                out["kv_pool"] = eng["kv_pool"]
+                out["live_slots"] = eng.get("live_slots")
             out["engine"] = eng
         return out
 

@@ -26,7 +26,17 @@ watchdog armed):
   admission chunk is being fused into the decode dispatch fails ONLY
   the admitting request — the streaming survivor's tokens stay
   bit-identical (its boundary falls back to a plain decode dispatch),
-  nothing leaks, and the next admission succeeds.
+  nothing leaks, and the next admission succeeds;
+- **page-pool exhaustion** (paged KV): a concurrent flood past the
+  free-page budget produces BOUNDED 429s with reason
+  ``no_free_pages`` (never a hang, never a 5xx), survivors stay
+  bit-identical, and at quiesce the pool holds zero leaked pages.
+
+The daemon runs the PAGED device KV layout (``kv_layout="paged"``,
+mlcomp_tpu/kvpool), so every scenario above also exercises the page
+pool's recovery contract — in particular the watchdog-restart
+scenarios prove ``pool.reset()`` rebuilds a clean allocator alongside
+the fresh device carry.
 
 Recovery invariants asserted after EVERY scenario:
 
@@ -63,7 +73,7 @@ from mlcomp_tpu.utils import faults  # noqa: E402
 class _Daemon:
     """The toy serving daemon + typed HTTP helpers."""
 
-    def __init__(self):
+    def __init__(self, **svc_kw):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -85,11 +95,20 @@ class _Daemon:
         # generous stall timeout at construction (the first dispatches
         # COMPILE, and compile time is busy time to the watchdog); the
         # stall scenario tightens it once the programs are warm
+        svc_kw.setdefault("kv_layout", "paged")
+        svc_kw.setdefault("max_slots", 4)
+        # roomy page pool: scenarios 0-5 test FAULT containment, and a
+        # pool sized to the dense-equal default (8 allocatable pages at
+        # this geometry) starves them into no_free_pages 429s once two
+        # 10-token streams and the registry's pins coexist — capacity
+        # limits get their own tightly-sized daemon in scenario 6
+        svc_kw.setdefault("kv_pages", 34)
         self.svc = GenerationService(
             model, {"params": params}, batch_sizes=(1, 2),
             prompt_buckets=(16,), max_new_buckets=(8,),
             prefix_cache=True, prefill_chunk=8,
             dispatch_stall_timeout=60.0,
+            **svc_kw,
         )
         self.httpd = make_http_server(self.svc, "127.0.0.1", 0, "chaos")
         threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
@@ -357,9 +376,87 @@ def run() -> dict:
             "watchdog": h["engine"]["watchdog"],
             "cache_degraded": h["engine"]["cache_degraded"],
         }
+        out["page_pool_exhaustion"] = _scenario_page_exhaustion()
         return out
     finally:
         faults.disarm_all()
+        d.close()
+
+
+def _scenario_page_exhaustion() -> dict:
+    """Scenario 6 — paged-KV pool exhaustion (its own daemon: the
+    shared daemon above runs a deliberately ROOMY pool so the fault
+    scenarios never starve; this one is sized tight so the flood
+    actually exhausts it).  A flood past the free-page budget must produce
+    BOUNDED 429s with reason ``no_free_pages`` (never a hang, never a
+    5xx), the accepted survivors' tokens must be bit-identical to an
+    unloaded run, and at quiesce the pool holds zero leaked pages."""
+    import threading as _threading
+
+    # TIGHT pool (the engine's dense-equal default at this geometry:
+    # 8 allocatable pages) so the flood actually exhausts it — the
+    # shared daemon's roomy pool would admit everything
+    d = _Daemon(kv_layout="paged", max_slots=4, kv_pages=10)
+    try:
+        probe = [9, 10, 11, 12, 13, 14, 15, 16, 17, 3]
+        code, payload = d.generate(probe)
+        assert code == 200, (code, payload)
+        baseline = payload["ids"]
+        d.svc.prefix_cache.flush()
+
+        results = []
+        lock = _threading.Lock()
+
+        def one(i):
+            code, payload = d.generate(
+                [9, 10, 11, 12, 13, 14, 15, 16, 17, (i % 40) + 3],
+                timeout=120,
+            )
+            with lock:
+                results.append((code, payload))
+
+        threads = [
+            _threading.Thread(target=one, args=(i,)) for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "a flood call hung"
+        ok = [p for c, p in results if c == 200]
+        rejected = [p for c, p in results if c == 429]
+        other = [(c, p) for c, p in results if c not in (200, 429)]
+        assert not other, f"non-contract responses: {other}"
+        assert len(ok) + len(rejected) == 16
+        assert ok, "the flood starved every request"
+        for p in rejected:
+            assert p.get("reason") == "no_free_pages", p
+            assert p.get("retry_after_s", 0) >= 1.0, p
+        # survivors bit-identical: same placement + same prompt shape
+        # as the probe — greedy decode under the paged layout must not
+        # be perturbed by neighbours, rejects, or elastic scaling
+        code, payload = d.generate(probe)
+        assert code == 200 and payload["ids"] == baseline, (code, payload)
+        d.assert_drained("page_exhaustion")
+        eng = d.svc.engine
+        pool = eng._pool
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 10:
+            pool.reclaim_all()  # registry pins are cache, not leaks
+            if pool.alloc.free_pages == pool.alloc.total_pages:
+                break
+            time.sleep(0.05)
+        st = pool.stats()
+        assert st["pages_free"] == st["pages_total"], st
+        assert st["outstanding_page_leases"] == 0, st
+        pool.check_invariants()
+        code, h = d.healthz()
+        assert code == 200 and h["ok"], (code, h)
+        return {
+            "accepted": len(ok), "rejected_429": len(rejected),
+            "survivors_exact": True, "pages_leaked": 0,
+        }
+    finally:
         d.close()
 
 

@@ -74,6 +74,15 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_engine_roofline_utilization",
     "mlcomp_engine_profile_captures_total",
     "mlcomp_engine_healthy",
+    "mlcomp_engine_kv_pages_total",
+    "mlcomp_engine_kv_pages_free",
+    "mlcomp_engine_kv_pages_shared",
+    "mlcomp_engine_kv_page_cow_forks_total",
+    "mlcomp_engine_slots_scaled_total",
+    "mlcomp_engine_live_slots",
+    "mlcomp_engine_max_slots",
+    "mlcomp_engine_kv_registry_hits_total",
+    "mlcomp_engine_kv_registry_hit_tokens_total",
     "mlcomp_engine_deadline_exceeded_total",
     "mlcomp_engine_cancelled_total",
     "mlcomp_engine_watchdog_stalls_total",
@@ -200,11 +209,15 @@ def run(n_requests: int = 4) -> dict:
     prompt = jnp.asarray(np.random.RandomState(0).randint(1, 64, (1, 8)))
     params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
     # prefill_chunk 8 divides the 16 bucket, so the prefix cache's hit
-    # path (and its metrics) can actually engage on repeated prompts
+    # path (and its metrics) can actually engage on repeated prompts;
+    # the PAGED KV layout (kvpool) runs live so its gauge/counter
+    # families — pool occupancy, COW forks, elastic slot scaling, the
+    # device prefix registry — are asserted against real traffic too
     svc = GenerationService(
         model, {"params": params}, batch_sizes=(1, 2),
         prompt_buckets=(16,), max_new_buckets=(8,),
         prefix_cache=True, prefill_chunk=8,
+        kv_layout="paged", max_slots=4, kv_pages=2 + 64,
     )
     httpd = make_http_server(svc, "127.0.0.1", 0, "obs-check")
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
@@ -289,13 +302,24 @@ def run(n_requests: int = 4) -> dict:
 
         for i in range(n_requests):
             generate(shared + [100 + i])
+            # a different LENGTH: same prefix at a different placement
+            # misses the placement-exact device registry and exercises
+            # the HOST prefix-cache tier (token-indexed, re-placed)
+            generate(shared + [100 + i, 7])
         text2 = get("/metrics").decode()
         s2, t2 = parse_exposition(text2)
         check_histograms(s2, t2)
         _counters_monotonic(s1, s2, t1)
         req1 = s2["mlcomp_engine_requests_total"][""]
-        assert req1 == req0 + n_requests, (req0, req1)
+        assert req1 == req0 + 2 * n_requests, (req0, req1)
         assert s2["mlcomp_prefix_cache_hits_total"][""] > 0
+        # paged-KV pool gauges carry live occupancy, and the device
+        # registry tier absorbed the same-placement repeats
+        kv_total = s2["mlcomp_engine_kv_pages_total"][""]
+        kv_free = s2["mlcomp_engine_kv_pages_free"][""]
+        assert kv_total > 0 and 0 <= kv_free <= kv_total
+        assert s2["mlcomp_engine_kv_registry_hits_total"][""] > 0
+        assert s2["mlcomp_engine_live_slots"][""] >= 1
 
         trace = json.loads(get("/trace?last_ms=600000"))
         evs = trace["traceEvents"]
@@ -311,7 +335,8 @@ def run(n_requests: int = 4) -> dict:
         assert begins and begins == ends, (begins, ends)
         names = {e["name"] for e in evs}
         for want in ("issue", "resolve", "request", "first_token",
-                     "prefill_chunk", "insert", "prefix_cache.lookup"):
+                     "prefill_chunk", "insert", "prefix_cache.lookup",
+                     "kv_registry.lookup"):
             assert want in names, f"missing trace span {want!r}"
         # the /profile capture merged a DEVICE track: a named
         # engine.device thread whose complete spans sit inside the
