@@ -188,7 +188,7 @@ def _set_result(fut: Future, result) -> None:
 class _Slot:
     __slots__ = (
         "req", "cursor", "position", "start", "remaining", "emitted",
-        "t_first",
+        "t_first", "span_end", "alloc_upto",
     )
 
     def __init__(self, req, cursor, position, start, remaining):
@@ -199,6 +199,12 @@ class _Slot:
         self.remaining = remaining    # tokens still allowed
         self.emitted: List[int] = []
         self.t_first = None           # host time the first token landed
+        # paged-layout lazy decode allocation (set at insert): the
+        # row's write span end, and the slot-coordinate frontier its
+        # allocated pages cover — _lazy_extend_tick grows the mapping
+        # as the cursor approaches the frontier
+        self.span_end = None
+        self.alloc_upto = None
 
 
 class _Admission:
@@ -521,12 +527,57 @@ class DecodeEngine:
                 )
             self._layout = layout
             self._pool = PagePool(layout, max_slots=self.max_slots)
-            # gather implementation: "auto" picks the Pallas
-            # scalar-prefetch DMA kernel on TPU and the jnp.take lax
-            # reference elsewhere; the env override is the bisect knob
-            # (lax on TPU isolates a kernel suspicion in one restart)
+            # attention data path (MLCOMP_TPU_PAGED_ATTN): how the
+            # decode dispatch reads/writes KV through the pages.
+            #   auto   (default) — FUSED: the dispatch core's attention
+            #          reads K/V through the page table directly (paged
+            #          Pallas kernels where the geometry keeps the
+            #          dense block partition, per-layer lax gathers
+            #          elsewhere) and appends the new token's K/V into
+            #          its page in place — no dense view materializes;
+            #   pallas — fused, and the paged kernels are REQUIRED
+            #          (ineligible geometry raises — the loud bisect);
+            #   lax    — the PR-7 reference sandwich: gather the dense
+            #          view, run the unchanged core, scatter back.
+            #          Kept everywhere as the correctness reference.
+            # All three are bit-identical to dense by construction and
+            # by test (tests/test_engine_paged.py).
+            self._paged_attn = os.environ.get(
+                "MLCOMP_TPU_PAGED_ATTN", "auto"
+            )
+            if self._paged_attn not in ("auto", "pallas", "lax"):
+                raise ValueError(
+                    "MLCOMP_TPU_PAGED_ATTN must be auto/pallas/lax, got "
+                    f"{self._paged_attn!r}"
+                )
+            # gather IMPLEMENTATION (the lax sandwich's dense-view
+            # gather, the registry's row-span fetches, and the fused
+            # path's per-layer fallback gathers — the non-quant family
+            # and kernel-ineligible geometries): "auto" picks the
+            # Pallas scalar-prefetch DMA kernel on TPU and the
+            # jnp.take lax reference elsewhere; the env override is
+            # the bisect knob (lax on TPU isolates a kernel suspicion
+            # in one restart).
             self._page_gather_impl = os.environ.get(
                 "MLCOMP_TPU_PAGE_GATHER", "auto"
+            )
+            # does the fused data path run the paged ATTENTION KERNELS
+            # (kv8 family whose buffer keeps the dense block partition
+            # in whole pages), or per-layer gather fallbacks?  Decides
+            # the bytes-moved cost model below.
+            from mlcomp_tpu.ops.pallas.decode_attention import (
+                paged_block_kv,
+            )
+
+            quant_specs = [
+                s for s in layout.kv_specs
+                if s.keystr.endswith("cached_key_q")
+            ]
+            self._kv_fused_kernels = bool(quant_specs) and all(
+                paged_block_kv(
+                    s.seq_len, s.shape[1], s.shape[3], T
+                ) is not None
+                for s in quant_specs
             )
 
         # weight prep mirrors generate(): entry-dequant everything the
@@ -587,9 +638,15 @@ class DecodeEngine:
             # means speculation is a pure loss on this traffic
             self._stats["spec_rows"] = 0
         if self._pool is not None:
-            # elastic-slot + device-registry accounting (paged only)
+            # elastic-slot + device-registry accounting (paged only),
+            # plus the lazy decode-page allocator's ledger: pages
+            # allocated as cursors crossed page boundaries mid-stream
+            # (instead of worst-case at insert), and the requests that
+            # hit a dry pool at such a crossing (bounded failure)
             self._stats["slots_scaled"] = 0
             self._stats["kv_registry_hit_tokens"] = 0
+            self._stats["kv_pages_lazy_allocated"] = 0
+            self._stats["kv_decode_page_failures"] = 0
         self._spec_warned = False
         self._fatblock_scale_warned = False
         # issued-but-unprocessed dispatches, oldest first: (packed
@@ -668,29 +725,34 @@ class DecodeEngine:
         self._profile: Optional[Dict[str, Any]] = None
         self._last_attr: Optional[Dict[str, Any]] = None
         # HBM-roofline accounting for the device-time attribution: one
-        # decode forward streams the full weight tree plus the whole
-        # allocated KV buffer (XLA attends the masked buffer; the
-        # Pallas kernels clamp at the cursor, so the count is
-        # conservative for them) — K forwards per scan dispatch, one
-        # per spec verify.  Shape metadata only: never touches (soon
-        # to be donated) device buffers.
-        w_bytes = sum(
+        # decode forward streams the full weight tree plus its KV
+        # working set — K forwards per scan dispatch, one per spec
+        # verify.  DENSE: the whole allocated buffer (XLA attends the
+        # masked buffer; the Pallas kernels clamp at the cursor, so
+        # the count is conservative for them).  PAGED: the LIVE pages
+        # only, read at roofline time — a forward reads exactly the
+        # mapped pages through the table, so charging the full pool
+        # would overstate bytes and flatter roofline_utilization on
+        # lightly-loaded engines.  Shape/pool metadata only: never
+        # touches (soon to be donated) device buffers.
+        self._w_bytes = sum(
             int(np.prod(leaf.shape)) * leaf.dtype.itemsize
             for leaf in jax.tree.leaves(self.variables)
         )
-        kv_bytes = (
-            self._layout.bytes_total() if self._layout is not None
+        # dense engines only (paged readers derive their dense-view
+        # counterfactual from the LIVE slot count at read time —
+        # elastic slots make a constructor-time figure stale)
+        self._kv_dense_bytes = (
+            0 if self._layout is not None
             else sum(
                 int(np.prod(leaf.shape)) * leaf.dtype.itemsize
                 for leaf in jax.tree.leaves(self._dstate["cache"])
             )
         )
-        forwards = 1 if self.spec_k is not None else self.steps_per_dispatch
-        self._hbm_gbps = float(os.environ.get("MLCOMP_TPU_HBM_GBPS", "819"))
-        self._roofline_bytes = forwards * (w_bytes + kv_bytes)
-        self._roofline_ms = (
-            self._roofline_bytes / (self._hbm_gbps * 1e9) * 1e3
+        self._forwards = (
+            1 if self.spec_k is not None else self.steps_per_dispatch
         )
+        self._hbm_gbps = float(os.environ.get("MLCOMP_TPU_HBM_GBPS", "819"))
         self.step_count = 0
         self._fns: Dict[Any, Any] = {}
         # chunk widths whose fused program has COMPILED AND RUN once
@@ -1264,6 +1326,20 @@ class DecodeEngine:
             ctr("mlcomp_engine_kv_registry_hit_tokens_total",
                 "Prompt tokens whose prefill a registry hit skipped",
                 st["kv_registry_hit_tokens"])
+            ctr("mlcomp_engine_kv_pages_lazy_allocated_total",
+                "Decode pages allocated lazily as cursors crossed page "
+                "boundaries mid-stream (instead of worst-case at "
+                "insert)", st["kv_pages_lazy_allocated"])
+            ctr("mlcomp_engine_kv_decode_page_failures_total",
+                "Requests failed mid-decode by a dry page pool at a "
+                "lazy page crossing (bounded failure)",
+                st["kv_decode_page_failures"])
+        gau("mlcomp_engine_kv_bytes_moved_per_dispatch",
+            "Estimated KV bytes one dispatch moves through HBM "
+            "(dense: K forwards x buffer; paged fused: K forwards x "
+            "live pages; paged lax sandwich: + the dense-view "
+            "gather/scatter round trip)",
+            self._kv_bytes_moved_per_dispatch())
         if self.prefix_cache is not None:
             cs = self.prefix_cache.stats()
             for key in ("lookups", "hits", "misses", "matched_tokens",
@@ -1695,6 +1771,98 @@ class DecodeEngine:
             self._fns["clear_row"] = jax.jit(clear, donate_argnums=(0,))
         return self._fns["clear_row"]
 
+    def _set_table_fn(self):
+        """Rewrite the WHOLE device page table from the host mirror
+        (lazy decode-page growth): ONE fixed-shape program per tick
+        however many slots crossed a page boundary together — at peak
+        short-stream concurrency whole cohorts cross in lockstep, and
+        a per-slot program would serialize that many tiny dispatches
+        onto the hot pre-issue boundary.  The mirror is authoritative
+        (insert/retire/extend all write it first), and the table is
+        (slots, max_pages) int32 — trivia next to one page.  Composes
+        onto the donated carry like _clear_row_fn: JAX sequences it
+        after in-flight dispatches (whose coverage was ensured at
+        THEIR issue) and before the next one."""
+        if "set_table" not in self._fns:
+            jax = self._jax
+
+            def set_table(dstate, table):
+                out = dict(dstate)
+                out["table"] = table
+                return out
+
+            self._fns["set_table"] = jax.jit(
+                set_table, donate_argnums=(0,)
+            )
+        return self._fns["set_table"]
+
+    def _lazy_extend_tick(self) -> None:
+        """Page-granular LAZY decode allocation (paged layout): before
+        each dispatch issues, make sure every live slot's mapping
+        covers the cache slots the in-flight window can write —
+        ``cursor + steps_hi * (inflight + 1) + 1``, capped at the
+        row's span.  Pages are allocated only as cursors approach page
+        boundaries, so admission control can overcommit the pool
+        against decode budgets (the admit-more headline).  A dry pool
+        here — after reclaiming registry pins — is the designed
+        BOUNDED failure: the starved row fails typed
+        (``NoFreePages``), frees its pages (often unblocking the next
+        starved row in the same tick), and the fleet decodes on."""
+        if self._pool is None:
+            return
+        from mlcomp_tpu.kvpool import NoFreePages
+
+        pool = self._pool
+        T = pool.page_tokens
+        jnp = self._jnp
+        lookahead = self._steps_hi() * (len(self._inflight) + 1) + 1
+        grew = False
+        for i, sl in enumerate(self._host):
+            if sl is None or sl.span_end is None:
+                continue
+            target = min(sl.span_end, sl.cursor + lookahead)
+            if target <= sl.alloc_upto:
+                continue
+            p0 = sl.alloc_upto // T
+            p1 = -(-target // T)
+            try:
+                try:
+                    pool.extend_slot_row(i, p0, p1)
+                except NoFreePages:
+                    # registry pins are cache, not commitments
+                    pool.reclaim(p1 - p0)
+                    pool.extend_slot_row(i, p0, p1)
+            except NoFreePages:
+                self._stats["kv_decode_page_failures"] += 1
+                self.recorder.instant(
+                    "kv_page_exhausted", track="engine.loop", slot=i,
+                    rid=sl.req.get("rid", 0),
+                )
+                err = NoFreePages(
+                    f"KV page pool exhausted mid-decode: slot {i} "
+                    f"needed {p1 - p0} page(s) at cursor {sl.cursor} "
+                    "(lazy decode allocation overcommits the pool; "
+                    "raise kv_pages or lower concurrency)"
+                )
+                # device first, then host — the same order the
+                # deadline/cancel retirement uses
+                self._dstate = self._deactivate_fn()(
+                    self._dstate, jnp.int32(i)
+                )
+                self._finish(i, error=err)
+                self._release_slot_pages(i)
+                continue
+            self._stats["kv_pages_lazy_allocated"] += p1 - p0
+            sl.alloc_upto = p1 * T
+            grew = True
+        if grew:
+            # one whole-table write for however many rows grew this
+            # tick (the host mirror is authoritative)
+            self._dstate = self._set_table_fn()(
+                self._dstate,
+                jnp.asarray(pool.tables[: len(self._host)]),
+            )
+
     def _release_slot_pages(self, slot: int) -> None:
         """Live-path slot teardown (paged): grave the device table row,
         then release the host-side page references.  Called wherever a
@@ -1730,15 +1898,50 @@ class DecodeEngine:
         )
         return start_pad, span_end
 
+    def _steps_hi(self) -> int:
+        """Upper bound on cache slots one dispatch advances a row: the
+        K-step scan writes K tokens, a spec dispatch writes K+1 verify
+        positions — the lazy allocator's lookahead unit."""
+        return (
+            self.spec_k + 1 if self.spec_k is not None
+            else self.steps_per_dispatch
+        )
+
     def _pages_worst(self, req: Dict[str, Any]) -> int:
         """Worst-case pages a request can occupy (prefix sharing only
-        ever reduces it): the number the admission gate, the serve
-        layer's 429 budget, and the scale-up check all budget with."""
+        ever reduces it) — the bound a request must fit INSIDE THE
+        WHOLE POOL to be servable at all.  Since lazy decode
+        allocation this is no longer the admission currency: see
+        :meth:`_pages_initial`."""
         s_bucket = self._bucket(len(req["ids"]))
         start_pad, span_end = self._slot_span(
             s_bucket, len(req["ids"]), req["n_new"]
         )
         return self._pool.pages_needed(start_pad, span_end)
+
+    def _alloc_end(self, s_bucket: int, span_end: int) -> int:
+        """The slot span the INSERT must back with pages: the prefill
+        content plus one dispatch of decode lookahead — everything
+        past it allocates lazily as the cursor approaches
+        (``_lazy_extend_tick``)."""
+        return min(span_end, s_bucket + self._steps_hi() + 1)
+
+    def _pages_initial(self, req: Dict[str, Any]) -> int:
+        """Pages a request needs AT ADMISSION under lazy decode
+        allocation: its prefill span plus one dispatch of lookahead —
+        the admission gate's currency since the fused-paged PR.
+        Strictly <= the worst case, which is exactly why free-page
+        admission control now admits more concurrent streams at equal
+        HBM (the pool overcommits against decode budgets; a dry pool
+        at a later page crossing is a BOUNDED failure, chaoscheck
+        scenario 7)."""
+        s_bucket = self._bucket(len(req["ids"]))
+        start_pad, span_end = self._slot_span(
+            s_bucket, len(req["ids"]), req["n_new"]
+        )
+        return self._pool.pages_needed(
+            start_pad, self._alloc_end(s_bucket, span_end)
+        )
 
     def _check_scale_fatblock(self, ns2: int) -> None:
         """Re-derive the int8 fat-block cliff at SCALE time: the
@@ -1846,7 +2049,7 @@ class DecodeEngine:
         if (self._adm is None and self._pending
                 and None not in self._host and ns < self.max_slots):
             try:
-                need = self._pages_worst(self._pending[0])
+                need = self._pages_initial(self._pending[0])
             except Exception:
                 return  # a bad bucket surfaces at admission, not here
             if need <= self._pages_available(need):
@@ -1871,25 +2074,31 @@ class DecodeEngine:
     def _pop_admittable(self) -> Optional[Dict[str, Any]]:
         """The FIFO head of the pending deque, if it can be admitted at
         this boundary.  Dense: always.  Paged: the head must fit the
-        free-page budget at its WORST case — a short pool DEFERS it
-        (rows retiring free pages, so progress is guaranteed while
-        anything decodes; FIFO order is preserved — no skip-ahead), and
-        a request bigger than the whole pool fails immediately."""
+        free-page budget at its INITIAL need — prefill pages plus one
+        dispatch of decode lookahead; later decode pages allocate
+        lazily, which is what lets the pool overcommit against decode
+        budgets and admit strictly more concurrent streams at equal
+        HBM.  A short pool DEFERS the head (rows retiring free pages,
+        so progress is guaranteed while anything decodes; FIFO order
+        is preserved — no skip-ahead), and a request whose WORST case
+        exceeds the whole pool fails immediately (it could never
+        finish)."""
         if self._pool is None:
             return self._pending.popleft()
         from mlcomp_tpu.kvpool import NoFreePages
 
         req = self._pending[0]
-        need = self._pages_worst(req)
         pool = self._pool
-        if need > pool.alloc.total_pages:
+        worst = self._pages_worst(req)
+        if worst > pool.alloc.total_pages:
             self._pending.popleft()
             self._fail_queued(req, NoFreePages(
-                f"request needs {need} pages worst-case; the pool holds "
+                f"request needs {worst} pages worst-case; the pool holds "
                 f"{pool.alloc.total_pages} (raise kv_pages or shrink the "
                 "request)"
             ))
             return None
+        need = self._pages_initial(req)
         if need > self._pages_available(need):
             return None
         return self._pending.popleft()
@@ -1932,17 +2141,31 @@ class DecodeEngine:
 
     def _carry_core(self):
         """The dispatch body over the engine's CARRY layout: the raw
-        core for the dense layout; for the paged layout, the same core
-        sandwiched between a page-table gather and scatter — the core
-        sees the exact dense view the dense engine carries (pure data
-        movement either side, no arithmetic), so paged outputs are
-        bit-identical to dense by construction.  Shared by the plain
-        jitted dispatch AND the fused prefill+decode family, like the
-        raw core itself."""
+        core for the dense layout.  For the paged layout the carry is
+        pages + table + cache scalars, and the data path is the
+        ``MLCOMP_TPU_PAGED_ATTN`` knob's:
+
+        - FUSED (auto/pallas, the hot path): the raw core itself runs
+          paged — its attention reads K/V through the page table
+          (``kvpool/attn``) and appends the new token's K/V into its
+          page in place.  No dense view materializes; the carry passes
+          straight through.
+        - LAX (the reference/bisect sandwich): gather the dense view,
+          run the DENSE core on it, scatter back — the PR-7 data path,
+          kept everywhere as the correctness reference.
+
+        Both are bit-identical to dense by construction (shared
+        arithmetic / pure data movement) and by test.  Shared by the
+        plain jitted dispatch AND the fused prefill+decode family,
+        like the raw core itself."""
         if self._layout is None:
             return self._dispatch_core()
         if "carry_core" not in self._fns:
             core = self._dispatch_core()
+            if self._paged_attn != "lax":
+                # FUSED: the core consumes the paged carry directly
+                self._fns["carry_core"] = core
+                return core
             layout = self._layout
             impl = self._page_gather_impl
 
@@ -1966,6 +2189,52 @@ class DecodeEngine:
 
             self._fns["carry_core"] = paged
         return self._fns["carry_core"]
+
+    def _kv_fused(self) -> bool:
+        """True when the dispatch cores run the FUSED paged data path
+        (paged layout, ``MLCOMP_TPU_PAGED_ATTN`` != lax): the KV carry
+        is the page tuple and attention goes through ``kvpool/attn``."""
+        return self._layout is not None and self._paged_attn != "lax"
+
+    def _kv_forward_fn(self, variables, dstate):
+        """The model-forward adapter the dispatch cores thread their
+        KV carry through: ``(kv, tok, positions, cursors, kv_mask) ->
+        (logits, kv')`` where ``kv`` is the dense cache pytree — or,
+        fused-paged, the page TUPLE (the table is dispatch-invariant
+        and closes over from the carry)."""
+        if not self._kv_fused():
+            def forward(kv, tok, positions, cursors, kv_mask):
+                logits, upd = self._apply(
+                    {**variables, "cache": kv}, tok, decode=True,
+                    positions=positions, kv_mask=kv_mask,
+                    cache_cursor=cursors, mutable=["cache"],
+                )
+                return logits, upd["cache"]
+
+            return forward
+        from mlcomp_tpu.kvpool.attn import PagedKV, paged_kv
+
+        layout = self._layout
+        impl = "pallas" if self._paged_attn == "pallas" else "auto"
+        gather_impl = self._page_gather_impl
+        table = dstate["table"]
+
+        def forward(kv, tok, positions, cursors, kv_mask):
+            ctx = PagedKV(layout, kv, table, impl=impl,
+                          gather_impl=gather_impl)
+            with paged_kv(ctx):
+                # no "cache" collection: the attention modules create
+                # no dense cache variables under the context, so the
+                # mutable pass-through is empty — pages come back via
+                # the context
+                logits, _ = self._apply(
+                    dict(variables), tok, decode=True,
+                    positions=positions, kv_mask=kv_mask,
+                    cache_cursor=cursors, mutable=["cache"],
+                )
+            return logits, tuple(ctx.pages)
+
+        return forward
 
     def _fused_dispatch_fn(self, c: int):
         """FUSED prefill+decode dispatch: one donated program that runs
@@ -2004,6 +2273,7 @@ class DecodeEngine:
         from mlcomp_tpu.models.generation import sample_token_rowwise
 
         K = self.steps_per_dispatch
+        fused_kv = self._kv_fused()
 
         def dispatch(variables, dstate):
             # slot count from the CARRY, not the constructor: elastic
@@ -2019,9 +2289,13 @@ class DecodeEngine:
             # slot's stale rp must not keep the (slots, V) penalty
             # path running for everyone
             penalty_on = jnp.any((rp_row != 1.0) & dstate["active"])
+            # the KV carry element: the dense cache pytree, or (fused
+            # paged) the page tuple — attention then reads/writes
+            # through the table via the kvpool context
+            forward = self._kv_forward_fn(variables, dstate)
 
             def one_step(carry, sub):
-                (cache, last_logits, presence, cursors, positions,
+                (kv, last_logits, presence, cursors, positions,
                  live, remaining) = carry
                 raw = last_logits
 
@@ -2044,14 +2318,12 @@ class DecodeEngine:
                 done_now = live & (
                     (tok == eos_row) | (remaining <= 0)
                 )
-                logits, upd = self._apply(
-                    {**variables, "cache": cache}, tok[:, None],
-                    decode=True, positions=positions[:, None],
-                    kv_mask=kv_mask, cache_cursor=cursors,
-                    mutable=["cache"],
+                logits, kv2 = forward(
+                    kv, tok[:, None], positions[:, None], cursors,
+                    kv_mask,
                 )
                 carry2 = (
-                    upd["cache"], logits[:, -1].astype(jnp.float32),
+                    kv2, logits[:, -1].astype(jnp.float32),
                     presence,
                     jnp.where(live, cursors + 1, cursors),
                     jnp.where(live, positions + 1, positions),
@@ -2062,8 +2334,11 @@ class DecodeEngine:
 
             rng, sub = jax.random.split(dstate["rng"])
             subs = jax.random.split(sub, K)
+            kv0 = (
+                tuple(dstate["pages"]) if fused_kv else dstate["cache"]
+            )
             carry0 = (
-                dstate["cache"], dstate["last_logits"],
+                kv0, dstate["last_logits"],
                 dstate["presence"], dstate["cursors"],
                 dstate["positions"], dstate["active"],
                 dstate["remaining"],
@@ -2072,9 +2347,13 @@ class DecodeEngine:
                 one_step, carry0, subs
             )
             out = dict(dstate)
-            (out["cache"], out["last_logits"], out["presence"],
+            (kv_out, out["last_logits"], out["presence"],
              out["cursors"], out["positions"], out["active"],
              out["remaining"]) = carry
+            if fused_kv:
+                out["pages"] = list(kv_out)
+            else:
+                out["cache"] = kv_out
             out["rng"] = rng
             packed = jnp.stack([
                 toks.astype(jnp.float32),
@@ -2101,6 +2380,7 @@ class DecodeEngine:
         from mlcomp_tpu.models.speculative import ngram_propose
 
         K = self.spec_k
+        fused_kv = self._kv_fused()
 
         def dispatch(variables, dstate):
             rows = jnp.arange(dstate["active"].shape[0])
@@ -2122,10 +2402,12 @@ class DecodeEngine:
             pos = dstate["positions"][:, None] + jnp.arange(
                 K + 1, dtype=jnp.int32
             )[None]
-            logits, upd = self._apply(
-                {**variables, "cache": dstate["cache"]}, seq,
-                decode=True, positions=pos, kv_mask=kv_mask,
-                cache_cursor=dstate["cursors"], mutable=["cache"],
+            forward = self._kv_forward_fn(variables, dstate)
+            kv0 = (
+                tuple(dstate["pages"]) if fused_kv else dstate["cache"]
+            )
+            logits, kv_out = forward(
+                kv0, seq, pos, dstate["cursors"], kv_mask
             )
             lg = logits.astype(jnp.float32)               # (slots, K+1, V)
             greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -2162,7 +2444,10 @@ class DecodeEngine:
                 jnp.int32(self.t_ids)
             )
             out = dict(dstate)
-            out["cache"] = upd["cache"]
+            if fused_kv:
+                out["pages"] = list(kv_out)
+            else:
+                out["cache"] = kv_out
             out["ids"] = dstate["ids"].at[rows[:, None], write_idx].set(
                 seq, mode="drop"
             )
@@ -2615,7 +2900,7 @@ class DecodeEngine:
         n = int(pr.get("resolved") or 0)
         att["dispatches"] = n
         att["requested_dispatches"] = pr["n"]
-        roof_ms = self._roofline_ms
+        roof_ms = self._roofline_ms()
         att["roofline_ms_per_dispatch"] = round(roof_ms, 4)
         dev, gap = att["device_time_ms"], att["host_gap_ms"]
         if n:
@@ -2685,7 +2970,7 @@ class DecodeEngine:
         resolve wait is then device-bound); captures are ground truth."""
         p = dict(self._pstats)
         done = self._stats["dispatches"]
-        roof_ms = self._roofline_ms
+        roof_ms = self._roofline_ms()
         ss = None
         if done:
             wall = (p["hidden_ms"] + p["wait_ms"]) / done
@@ -2721,7 +3006,10 @@ class DecodeEngine:
             util = ss["roofline_utilization_est"]
         return {
             "hbm_gbps": self._hbm_gbps,
-            "roofline_bytes_per_dispatch": self._roofline_bytes,
+            "roofline_bytes_per_dispatch": self._roofline_bytes(),
+            "kv_bytes_moved_per_dispatch": (
+                self._kv_bytes_moved_per_dispatch()
+            ),
             "roofline_ms_per_dispatch": round(roof_ms, 4),
             "device_time_ms_per_dispatch": per,
             "host_overhead_ms_per_dispatch": host_ms,
@@ -2734,6 +3022,64 @@ class DecodeEngine:
             "steady_state": ss,
             "last_capture": cap,
         }
+
+    # -------------------------------------------------- bytes accounting
+
+    def _kv_live_bytes(self) -> int:
+        """Paged: bytes of the live page MAPPINGS — the KV working set
+        a fused forward actually reads through the tables, counted per
+        slot-table entry rather than per physical page: a COW-shared
+        prefix page is DMA'd once per slot that maps it (each row's
+        table-driven block fetch is independent), and registry-only
+        pinned pages (no slot row maps them) cost a forward nothing.
+        Scrape/stats-time only; the mirror may be mid-mutation under
+        an HTTP-thread read — a torn count is acceptable monitoring,
+        same contract as ``_stats``."""
+        from mlcomp_tpu.kvpool import RESERVED_PAGES
+
+        rows = self._pool.tables[: len(self._host)]
+        return int((rows >= RESERVED_PAGES).sum()) * (
+            self._layout.page_bytes()
+        )
+
+    def _roofline_bytes(self) -> int:
+        """HBM bytes one dispatch MUST move: weights once per forward
+        plus the KV working set (dense buffer, or live pages under the
+        paged layout — the honest denominator the roofline satellite
+        fixed: charging the full buffer overstated paged bytes)."""
+        kv = (
+            self._kv_live_bytes() if self._pool is not None
+            else self._kv_dense_bytes
+        )
+        return self._forwards * (self._w_bytes + kv)
+
+    def _roofline_ms(self) -> float:
+        return self._roofline_bytes() / (self._hbm_gbps * 1e9) * 1e3
+
+    def _kv_bytes_moved_per_dispatch(self) -> int:
+        """Estimated KV bytes one dispatch moves through HBM — the
+        cost model behind ``mlcomp_engine_kv_bytes_moved_per_dispatch``
+        and bench's fused-vs-gather A/B.  Dense: K forwards read the
+        buffer.  Paged FUSED: K forwards read the live pages (the
+        whole point of the fused path — per-token appends are noise).
+        Paged LAX sandwich: the gather reads the live pages and writes
+        the dense view, the core reads it K times, the scatter reads
+        it back and rewrites the pages — the round trip the fused path
+        deletes."""
+        fw = self._forwards
+        if self._pool is None:
+            return fw * self._kv_dense_bytes
+        live = self._kv_live_bytes()
+        dense = self._layout.dense_view_bytes(len(self._host))
+        if self._paged_attn != "lax":
+            if self._kv_fused_kernels:
+                return fw * live
+            # per-layer gather FALLBACK (non-quant family, kernel-
+            # ineligible geometry): each forward still reads the live
+            # pages and round-trips a transient dense view through the
+            # attention consumer — not the kernels' page-streaming win
+            return fw * (live + 2 * dense)
+        return (fw + 2) * dense + 2 * live
 
     def _complete_admission(self) -> None:
         """Final admission boundary — the ONE synchronous stall the
@@ -2820,9 +3166,16 @@ class DecodeEngine:
             start_pad, span_end = self._slot_span(
                 s_bucket, len(req["ids"]), req["n_new"]
             )
+            # LAZY decode allocation: back only the prefill content
+            # plus one dispatch of lookahead now; later decode pages
+            # allocate as the cursor approaches them
+            # (_lazy_extend_tick) — the admission gate budgeted this
+            # same alloc_end (_pages_initial)
+            alloc_end = self._alloc_end(s_bucket, span_end)
             try:
                 prow, pmask, _forks = pool.build_slot_row(
-                    start_pad, span_end, shared=adm.page_lease
+                    start_pad, span_end, shared=adm.page_lease,
+                    alloc_end=alloc_end,
                 )
             except NoFreePages:
                 # genuinely short of PRIVATE pages (shared mappings
@@ -2833,10 +3186,12 @@ class DecodeEngine:
                 # retry once; a second failure is the admission-scoped
                 # error the docstring promises
                 pool.reclaim(pool.private_pages_needed(
-                    start_pad, span_end, shared=adm.page_lease
+                    start_pad, span_end, shared=adm.page_lease,
+                    alloc_end=alloc_end,
                 ))
                 prow, pmask, _forks = pool.build_slot_row(
-                    start_pad, span_end, shared=adm.page_lease
+                    start_pad, span_end, shared=adm.page_lease,
+                    alloc_end=alloc_end,
                 )
             wsel = np.where(pmask, prow, GRAVE_PAGE).astype(np.int32)
             extra = (jnp.asarray(prow), jnp.asarray(wsel)) + extra
@@ -2868,13 +3223,24 @@ class DecodeEngine:
                 if adm.page_lease is not None:
                     adm.page_lease.release()
                     adm.page_lease = None
-        self._host[slot] = _Slot(
+        sl = _Slot(
             req,
             cursor=s_bucket,
             position=len(req["ids"]),
             start=s_bucket - len(req["ids"]),
             remaining=req["n_new"],
         )
+        if self._pool is not None:
+            # lazy-allocation bookkeeping: the committed row covers
+            # page-aligned slots up to ceil(alloc_end / T) * T
+            start_pad, span_end = self._slot_span(
+                s_bucket, len(req["ids"]), req["n_new"]
+            )
+            T = self._pool.page_tokens
+            sl.span_end = span_end
+            sl.alloc_upto = -(-self._alloc_end(s_bucket, span_end)
+                              // T) * T
+        self._host[slot] = sl
 
     def _finish(self, slot_idx: int, error: Optional[Exception] = None):
         sl = self._host[slot_idx]
@@ -2947,6 +3313,10 @@ class DecodeEngine:
         carried cache, advancing the admission without a dedicated
         dispatch — the decode stream never pauses for it."""
         seq = next(self._dispatch_seq)
+        # lazy decode-page growth BEFORE the issue: the dispatch about
+        # to go out (plus everything already in flight) must find every
+        # cache slot it can write backed by a page
+        self._lazy_extend_tick()
         self._busy_since = time.perf_counter()
         try:
             # chaos surface: raise = dispatch exception (the loop fails

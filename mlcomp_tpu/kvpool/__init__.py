@@ -11,13 +11,19 @@ active slot count scales with live traffic under a free-page budget.
 
 - ``allocator``: host free-list + ref-count bookkeeping (reserved
   NULL/GRAVE pages, COW-fork accounting);
-- ``layout``: traced gather/scatter between pages and the dense view
-  the decode programs consume (``jnp.take`` lax fallback everywhere,
-  scalar-prefetch Pallas DMA gather on TPU) — bit-equality with the
-  dense layout by construction;
-- ``pool``: slot-row policy, the device prefix-page registry, stats.
+- ``layout``: the paged form of each cache leaf (pages are dense-layout
+  tiles) plus the traced gather/scatter between pages and the dense
+  view — the lax REFERENCE path (``jnp.take`` fallback everywhere,
+  scalar-prefetch Pallas DMA gather on TPU), bit-equal by construction;
+- ``attn``: the fused path — a trace-time context the engine installs
+  so decode attention reads K/V THROUGH the page table (paged Pallas
+  kernels / per-layer lax gathers) and appends the new token's K/V
+  into its page in place: no dense view materializes at all;
+- ``pool``: slot-row policy, lazy decode-page growth, the device
+  prefix-page registry, stats.
 
-``mlcomp_tpu/engine.py`` wires it in behind ``kv_layout="paged"``;
+``mlcomp_tpu/engine.py`` wires it in behind ``kv_layout="paged"``
+(``MLCOMP_TPU_PAGED_ATTN`` picks fused vs reference);
 ``docs/serving.md`` ("Paged KV") documents the policies.
 """
 
@@ -27,6 +33,11 @@ from mlcomp_tpu.kvpool.allocator import (  # noqa: F401
     RESERVED_PAGES,
     NoFreePages,
     PageAllocator,
+)
+from mlcomp_tpu.kvpool.attn import (  # noqa: F401
+    PagedKV,
+    current_paged_kv,
+    paged_kv,
 )
 from mlcomp_tpu.kvpool.layout import PagedLayout  # noqa: F401
 from mlcomp_tpu.kvpool.pool import PageLease, PagePool  # noqa: F401

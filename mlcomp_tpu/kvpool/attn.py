@@ -1,0 +1,177 @@
+"""Fused paged attention: the trace-time context that routes the
+model's decode-attention K/V reads and writes THROUGH the page table.
+
+The PR-7 paged dispatch kept the decode core oblivious: gather the
+dense view, run the unchanged core, scatter back — correct, but every
+dispatch moved ~2x the live slots' KV bytes through HBM as pure data
+movement.  This module is the other half of killing that round trip:
+the engine installs a :class:`PagedKV` context around the dispatch
+core's model apply, and the attention modules
+(``models/transformer.SelfAttention``), seeing it, stop creating their
+dense cache variables entirely —
+
+- the per-token K/V APPEND scatters the new rows straight into their
+  physical pages (``append_rows``: page id from the table at
+  ``cursor // T``, offset ``cursor % T``).  Routing falls out of the
+  table itself: a retired row's all-GRAVE table parks its
+  frozen-cursor writes on the graveyard page, NULL is never mapped
+  inside a write span, and COW-shared prefix pages sit below the
+  decode span by the pool's allocation policy — the masked-page-write
+  discipline ``PagedLayout.insert_rows`` uses, applied per token;
+- the attention READ runs the paged Pallas kernels
+  (``ops/pallas/decode_attention.paged_decode_attention[_chunk]``)
+  when the geometry is eligible — pages stream HBM->VMEM straight
+  from the pool arrays, block-index-from-prefetched-table — and
+  otherwise a per-layer ``jnp.take`` gather feeding the DENSE kernels
+  (``gather_dense``), which is bit-identical by data movement.
+
+Because the context holds TRACERS (the pages ride the engine's donated
+carry), it is strictly trace-scoped: the engine creates it inside the
+jitted dispatch body, the modules mutate ``ctx.pages`` in place, and
+the engine reads the updated tuple back into the carry after apply
+returns.  Thread-local storage keeps concurrent traces (engine loop vs
+warmup) independent.
+
+Whether any of this is active at all is the engine's
+``MLCOMP_TPU_PAGED_ATTN`` knob (``auto`` | ``pallas`` | ``lax``):
+``lax`` keeps the PR-7 gather/scatter sandwich as the
+everywhere-reference and this module idle; ``auto`` fuses with the
+Pallas kernels where eligible; ``pallas`` fuses and REQUIRES the
+kernels (the loud bisect mode).  See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, List, Sequence
+
+_TLS = threading.local()
+
+
+def current_paged_kv():
+    """The installed :class:`PagedKV` context, or None (dense mode)."""
+    return getattr(_TLS, "ctx", None)
+
+
+@contextmanager
+def paged_kv(ctx: "PagedKV"):
+    """Install ``ctx`` for the enclosed trace (the engine wraps the
+    dispatch core's model apply in exactly one of these)."""
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _TLS.ctx = prev
+
+
+class PagedKV:
+    """One dispatch's paged-KV view: the page arrays (mutated in place
+    as layers append), the slot page table, the static layout, and the
+    kernel policy (``impl``: "auto" | "pallas")."""
+
+    def __init__(self, layout, pages: Sequence[Any], table,
+                 impl: str = "auto", gather_impl: str = "auto"):
+        self.layout = layout
+        self.pages: List[Any] = list(pages)
+        self.table = table
+        self.impl = impl
+        # implementation for the per-layer dense-view gathers the
+        # FALLBACK routes take (non-quant family, kernel-ineligible
+        # geometries): the same MLCOMP_TPU_PAGE_GATHER knob as the lax
+        # sandwich — "auto" keeps the Pallas DMA copy kernel on TPU
+        self.gather_impl = gather_impl
+
+    # ------------------------------------------------------------ resolve
+
+    def index_of(self, prefix: str, name: str) -> int:
+        """kv_specs index of the cache leaf ``<prefix>/<name>`` — the
+        attention module resolves its own leaves by its flax path."""
+        key = f"{prefix}/{name}" if prefix else name
+        idx = self.layout.kv_index.get(key)
+        if idx is None:
+            raise KeyError(
+                f"paged KV context has no leaf {key!r}: the module tree "
+                "does not match the layout's cache pytree"
+            )
+        return idx
+
+    def spec(self, idx: int):
+        return self.layout.kv_specs[idx]
+
+    @property
+    def page_tokens(self) -> int:
+        return self.layout.page_tokens
+
+    # ------------------------------------------------------------- writes
+
+    def append_rows(self, idx: int, rows, pos, values) -> None:
+        """Scatter per-(row, token) values into their pages in place:
+        entry ``n`` writes ``values[n]`` at cache slot ``pos[n]`` of
+        batch row ``rows[n]`` — physical page ``table[row, pos//T]``,
+        in-page offset ``pos % T``.  Values must already match the
+        leaf's storage dtype (the caller owns the cast, exactly like
+        the dense write path).  Duplicate GRAVE targets (several
+        retired rows) are fine: the graveyard's content is never
+        read."""
+        import jax.numpy as jnp
+
+        spec = self.spec(idx)
+        T = self.layout.page_tokens
+        pos = jnp.asarray(pos)
+        pid = self.table[jnp.asarray(rows), pos // T]
+        page = self.pages[idx]
+        index: List[Any] = [slice(None)] * page.ndim
+        index[0] = pid
+        index[spec.slot_axis] = pos % T
+        self.pages[idx] = page.at[tuple(index)].set(values)
+
+    # -------------------------------------------------------------- reads
+
+    def gather_dense(self, idx: int):
+        """This leaf's full dense view through the table (``jnp.take``)
+        — the per-layer lax read the non-quant family and ineligible
+        geometries fuse into their attention consumer.  Transient: the
+        view lives only inside this layer's attention computation,
+        never in the carry, and nothing scatters it back.
+
+        The view is MATERIALIZED behind an optimization barrier:
+        without it XLA fuses the gather into the attention dot, whose
+        different operand path reorders the fp accumulation by a few
+        ulps — the dense engine's dot consumes a plain buffer, and
+        bit-equality is the layout's contract."""
+        import jax
+
+        spec = self.spec(idx)
+        view = self.layout.gather_leaf(
+            spec, self.pages[idx], self.table, impl=self.gather_impl
+        )
+        return jax.lax.optimization_barrier(view)
+
+    def kernel_table(self, idx: int):
+        """The table columns covering this leaf's buffer, for the paged
+        kernels (MP * T must equal the leaf's seq_len there)."""
+        spec = self.spec(idx)
+        n_cols = spec.seq_len // self.layout.page_tokens
+        return self.table[:, :n_cols]
+
+    def use_pallas_kernels(self, idx: int, h_kv: int, dh: int) -> bool:
+        """Kernel-eligibility policy: ``pallas`` requires them (raises
+        when the geometry cannot keep the dense block partition —
+        bit-equality would silently break); ``auto`` falls back to the
+        gather + dense-kernel read."""
+        from mlcomp_tpu.ops.pallas.decode_attention import paged_block_kv
+
+        spec = self.spec(idx)
+        ok = paged_block_kv(
+            spec.seq_len, h_kv, dh, self.layout.page_tokens
+        ) is not None
+        if not ok and self.impl == "pallas":
+            raise NotImplementedError(
+                f"MLCOMP_TPU_PAGED_ATTN=pallas but leaf {spec.keystr} "
+                f"(buffer {spec.seq_len}, page {self.layout.page_tokens} "
+                "tokens) cannot keep the dense kernel's block partition; "
+                "use auto (gather fallback) or lax (reference sandwich)"
+            )
+        return ok

@@ -1,36 +1,49 @@
 """Paged layout over the engine's KV cache pytree: TRACED gather and
-scatter between ``(num_pages, page_tokens, ...)`` page arrays and the
-dense ``(slots, l_buf, ...)`` view the decode programs consume.
+scatter between page arrays and the dense ``(slots, l_buf, ...)`` view,
+plus the geometry the fused paged-attention path reads pages through.
 
-The design constraint is BIT-EQUALITY with the dense layout: the paged
-dispatch gathers the dense view through the slot page tables, runs the
-UNCHANGED dispatch core on it, and scatters the updated view back —
-the decode math never sees a different buffer, so paged outputs equal
-dense outputs by construction (enforced again by test).  Gather and
-scatter are pure data movement (transpose/reshape/take/scatter — no
-arithmetic), so the round trip is exact for every dtype the cache
-families use (f32/bf16 K/V, int8 kv8 blocks, bf16 scales).
+The design constraint is BIT-EQUALITY with the dense layout, by two
+routes:
+
+- the LAX REFERENCE path gathers the dense view through the slot page
+  tables, runs the UNCHANGED dispatch core on it, and scatters the
+  updated view back — pure data movement (pad/reshape/moveaxis/take/
+  scatter — no arithmetic), exact for every dtype the cache families
+  use (f32/bf16 K/V, int8 kv8 blocks, bf16 scales);
+- the FUSED path (``kvpool/attn.py`` + the paged Pallas kernels in
+  ``ops/pallas/decode_attention.py``) never materializes the dense
+  view: the decode kernels DMA pages straight from the pool arrays,
+  block-index-from-prefetched-table, and the per-token K/V append
+  scatters into its page in place.  Bit-equality there comes from the
+  PAGE SHAPE: a page is a dense-layout tile.
 
 Layout rules, shared with the host prefix cache
 (``cache/kv_store.SLOT_AXES``): every KV leaf has a batch (slot) axis 0
-and a sequence (cache-slot) axis; its page array replaces axis 0 with
-the physical-page axis and the sequence axis with ``page_tokens``.
-Non-KV leaves (``cache_index`` scalars) are slot-count-independent and
-ride the paged carry untouched.
+and a sequence (cache-slot) axis.  Its page array drops the batch axis,
+puts the physical-page axis first, and shrinks the sequence axis to
+``page_tokens`` IN PLACE — e.g. a dense ``(S, Hkv, L, dh)`` kv8 leaf
+pages as ``(num_pages, Hkv, T, dh)``.  Keeping the dense axis order is
+what lets the fused attention kernels copy a page into a dense-shaped
+VMEM block with no in-kernel transpose, so the fused compute runs the
+EXACT math (same block partition, same accumulation order) as the dense
+kernel.  Non-KV leaves (``cache_index`` scalars) are
+slot-count-independent and ride the paged carry untouched.
 
-The gather has two implementations:
+The reference gather has two implementations:
 
 - ``lax``: ``jnp.take`` over the page axis — runs everywhere, the
   correctness reference (CPU tests run this path);
 - ``pallas``: a scalar-prefetch DMA copy kernel
   (``PrefetchScalarGridSpec``; the page table is prefetched so each
   grid step's block index comes straight from it) — one HBM pass with
-  no intermediate (slots*max_pages, ...) index materialization.  TPU
-  only; ``impl="auto"`` picks it there and falls back to ``lax``
-  elsewhere.  This is the gather the decode kernels read through; a
-  fully fused paged-attention kernel (no dense view at all) is the
-  open follow-up once the engine's attention paths take page tables
-  directly.
+  no intermediate index materialization.  TPU only; ``impl="auto"``
+  picks it there and falls back to ``lax`` elsewhere.
+
+Whether any of this runs at all is the engine's
+``MLCOMP_TPU_PAGED_ATTN`` knob: ``lax`` keeps the gather/scatter
+sandwich as the everywhere-reference, everything else reads K/V
+through the page table directly and this module's gather/scatter serve
+only the reference/bisect path (see docs/serving.md).
 """
 
 from __future__ import annotations
@@ -101,6 +114,9 @@ class PagedLayout:
                          int(leaf.shape[ax]))
             )
         self.kv_specs = [s for s in self.leaves if s.slot_axis is not None]
+        # fused-attention lookup: leaf keystr -> kv_specs index (the
+        # attention modules resolve their own cache leaves by path)
+        self.kv_index = {s.keystr: i for i, s in enumerate(self.kv_specs)}
         # table width: enough pages to cover the LONGEST leaf buffer
         # (the kv8 family lane-rounds past l_buf); each leaf gathers
         # through only its own first ceil(seq_len/T) table columns, and
@@ -121,12 +137,16 @@ class PagedLayout:
         return self.num_pages
 
     def page_shape(self, spec: LeafSpec) -> Tuple[int, ...]:
-        # the page axis replaces the slot batch axis, with the sequence
-        # axis next to it so a page is one contiguous (T, rest) tile
+        # a page is a dense-layout TILE: drop the batch axis, put the
+        # physical-page axis first, shrink the sequence axis to T in
+        # place — the fused kernels DMA a page into a dense-shaped
+        # VMEM block with no transpose
+        return (self._require_pages(),) + self._page_rest(spec)
+
+    def _page_rest(self, spec: LeafSpec) -> Tuple[int, ...]:
         return tuple(
-            [self._require_pages(), self.page_tokens]
-            + [d for i, d in enumerate(spec.shape)
-               if i not in (0, spec.slot_axis)]
+            self.page_tokens if i == spec.slot_axis else d
+            for i, d in enumerate(spec.shape) if i != 0
         )
 
     def fresh_pages(self) -> List[Any]:
@@ -145,10 +165,8 @@ class PagedLayout:
 
         total = 0
         for s in self.kv_specs:
-            rest = [d for i, d in enumerate(s.shape)
-                    if i not in (0, s.slot_axis)]
             total += (
-                self.page_tokens * int(np.prod(rest, dtype=np.int64))
+                int(np.prod(self._page_rest(s), dtype=np.int64))
                 * np.dtype(s.dtype).itemsize
             )
         return total
@@ -156,79 +174,89 @@ class PagedLayout:
     def bytes_total(self) -> int:
         return self.page_bytes() * self._require_pages()
 
+    def dense_view_bytes(self, slots: int) -> int:
+        """Bytes of the DENSE view at ``slots`` rows — what the lax
+        reference path materializes (and moves) per gather/scatter,
+        and the honest per-forward KV read of a dense-layout engine."""
+        import numpy as np
+
+        total = 0
+        for s in self.kv_specs:
+            total += (
+                int(np.prod(s.shape[1:], dtype=np.int64))
+                * np.dtype(s.dtype).itemsize
+            )
+        return total * int(slots)
+
     # ------------------------------------------------------------- tracing
 
-    def _rest_axes(self, spec: LeafSpec) -> List[int]:
-        return [
-            i for i in range(len(spec.shape))
-            if i not in (0, spec.slot_axis)
-        ]
-
-    def _dense_order(self, spec: LeafSpec) -> List[int]:
-        """Axes argument mapping canonical (S, seq, rest...) back to
-        the dense leaf layout: dense axis i reads canonical axis
-        order[i]."""
-        order = [0] * len(spec.shape)
-        order[0] = 0
-        order[spec.slot_axis] = 1
-        for j, i in enumerate(self._rest_axes(spec)):
-            order[i] = 2 + j
-        return order
-
-    def _to_view(self, spec: LeafSpec, rows):
-        """(S, MP*T, rest...) canonical rows -> dense leaf layout.
-        Sliced to the LEAF's own buffer length: the kv8 family
-        lane-rounds past l_buf, and each leaf rebuilds exactly the
-        shape the model allocated."""
-        import jax.numpy as jnp
-
-        rows = rows[:, : spec.seq_len]
-        return jnp.transpose(rows, axes=self._dense_order(spec))
-
     def _from_view(self, spec: LeafSpec, leaf):
-        """Dense leaf -> (S, MP*T, rest...) canonical rows, zero-padded
+        """Dense leaf -> (S, MP, *page_rest) page tiles, zero-padded
         from the leaf's seq_len up to MP*T (the pad lands beyond every
         slot's span, on pages whose gathered content was zero — see
         scatter)."""
         import jax.numpy as jnp
 
-        perm = [0, spec.slot_axis] + self._rest_axes(spec)
-        rows = jnp.transpose(leaf, axes=perm)
-        pad = self.max_pages * self.page_tokens - spec.seq_len
+        ax = spec.slot_axis
+        T = self.page_tokens
+        pad = self.max_pages * T - spec.seq_len
         if pad:
-            rows = jnp.pad(rows, [(0, 0), (0, pad)] + [(0, 0)] * (
-                rows.ndim - 2
-            ))
-        return rows
+            widths = [(0, 0)] * leaf.ndim
+            widths[ax] = (0, pad)
+            leaf = jnp.pad(leaf, widths)
+        shape = (
+            leaf.shape[:ax] + (self.max_pages, T) + leaf.shape[ax + 1:]
+        )
+        return jnp.moveaxis(leaf.reshape(shape), ax, 1)
+
+    def _rows_to_view(self, spec: LeafSpec, rows,
+                      width: Optional[int] = None):
+        """(S, n_cols, *page_rest) gathered page tiles -> the dense
+        leaf layout, sliced to ``width`` slots (default: the LEAF's
+        own buffer length — the kv8 family lane-rounds past l_buf, and
+        each leaf rebuilds exactly the shape the model allocated;
+        registry-hit span gathers pass their chunk-aligned prefix
+        width instead)."""
+        import jax.numpy as jnp
+
+        ax = spec.slot_axis
+        T = self.page_tokens
+        n_cols = rows.shape[1]
+        rows = jnp.moveaxis(rows, 1, ax)   # (S, d1.., n_cols, T, .., dn)
+        shape = rows.shape[:ax] + (n_cols * T,) + rows.shape[ax + 2:]
+        rows = rows.reshape(shape)
+        index = [slice(None)] * rows.ndim
+        index[ax] = slice(0, spec.seq_len if width is None else width)
+        return rows[tuple(index)]
+
+    def gather_leaf(self, spec: LeafSpec, pages, table, impl: str = "lax"):
+        """TRACED: ONE leaf's dense view through ``table`` — the unit
+        the reference gather and the fused path's per-layer lax reads
+        (non-quant family, ineligible geometries) share."""
+        n_cols = -(-spec.seq_len // self.page_tokens)
+        rows = _gather_leaf(pages, table[:, :n_cols], impl=impl)
+        return self._rows_to_view(spec, rows)
 
     def gather(self, pages: Sequence[Any], table, scalars: Sequence[Any],
                impl: str = "auto"):
         """TRACED: rebuild the dense cache pytree from page arrays
         through ``table`` (S, max_pages) int32.  ``scalars`` are the
-        non-KV leaves in layout order."""
-        import jax.numpy as jnp
-
+        non-KV leaves in layout order.  The lax REFERENCE path — the
+        fused attention path never calls this on the hot path."""
         views, ki, si = [], 0, 0
         for spec in self.leaves:
             if spec.slot_axis is None:
                 views.append(scalars[si])
                 si += 1
                 continue
-            pg = pages[ki]
-            ki += 1
             # only this leaf's own columns: pages past ceil(seq_len/T)
             # map NULL for every slot (the table is sized to the
             # LONGEST leaf), so gathering them would move zeros the
-            # _to_view slice discards anyway
-            n_cols = -(-spec.seq_len // self.page_tokens)
-            rows = _gather_leaf(
-                pg, table[:, :n_cols], self.page_tokens, impl=impl
-            )  # (S, n_cols, T, rest...)
-            rows = rows.reshape(
-                (rows.shape[0], n_cols * self.page_tokens)
-                + rows.shape[3:]
+            # _rows_to_view slice discards anyway
+            views.append(
+                self.gather_leaf(spec, pages[ki], table, impl=impl)
             )
-            views.append(self._to_view(spec, rows))
+            ki += 1
         return self.treedef.unflatten(views)
 
     def scatter(self, pages: Sequence[Any], table, cache) -> List[Any]:
@@ -251,7 +279,7 @@ class PagedLayout:
                 continue
             rows = self._from_view(spec, leaf)
             rows = rows.reshape(
-                (S * self.max_pages, self.page_tokens) + rows.shape[2:]
+                (S * self.max_pages,) + rows.shape[2:]
             )
             out.append(pages[ki].at[flat_tbl].set(rows))
             ki += 1
@@ -276,9 +304,11 @@ class PagedLayout:
         everywhere else — shared prefix pages keep their bytes (the
         copy-on-write mapping: the admission recomputed identical
         bytes, and routing them to the graveyard is what makes the
-        shared page a zero-copy reference), and NULL stays untouched.
-        Duplicate GRAVE targets are fine: the graveyard's content is
-        never read."""
+        shared page a zero-copy reference), NULL stays untouched, and
+        LAZY decode pages (allocated later, as the cursor approaches)
+        receive nothing here because they do not exist yet.  Duplicate
+        GRAVE targets are fine: the graveyard's content is never
+        read."""
         import jax
 
         flat, _ = jax.tree_util.tree_flatten_with_path(cache)
@@ -287,10 +317,7 @@ class PagedLayout:
         for spec, leaf in zip(self.leaves, dense):
             if spec.slot_axis is None:
                 continue
-            rows = self._from_view(spec, leaf)
-            rows = rows.reshape(
-                (self.max_pages, self.page_tokens) + rows.shape[2:]
-            )
+            rows = self._from_view(spec, leaf)[0]  # (MP, *page_rest)
             out.append(pages[ki].at[write_sel].set(rows))
             ki += 1
         return out
@@ -302,21 +329,15 @@ class PagedLayout:
         from ``page_ids`` (the span's table entries, device int32) —
         the device-to-device half of a prefix-registry hit: no host
         round-trip, the persistent pages stay shared."""
-        import jax.numpy as jnp
-
-        n_pages = -(-width // self.page_tokens)
         out = []
         for spec, pg in zip(self.kv_specs, pages):
-            rows = pg[page_ids]  # (n_pages, T, rest...)
-            rows = rows.reshape(
-                (1, n_pages * self.page_tokens) + rows.shape[2:]
-            )[:, :width]
-            out.append(jnp.transpose(rows, axes=self._dense_order(spec)))
+            rows = pg[page_ids][None]    # (1, n_pages, *page_rest)
+            out.append(self._rows_to_view(spec, rows, width=width))
         return out
 
 
-def _gather_leaf(pages, table, page_tokens: int, impl: str = "auto"):
-    """(P, T, rest...) pages + (S, MP) table -> (S, MP, T, rest...).
+def _gather_leaf(pages, table, impl: str = "auto"):
+    """(P, *page_rest) pages + (S, MP) table -> (S, MP, *page_rest).
 
     ``impl``: "lax" (jnp.take — everywhere), "pallas" (TPU DMA-copy
     kernel), "auto" (pallas on TPU, else lax).
@@ -344,39 +365,41 @@ def _gather_leaf_pallas(pages, table, interpret: bool = False):
     drives each step's input block index, so block (s, p) DMA-copies
     physical page ``table[s, p]`` into logical position (s, p) — one
     HBM pass, no index arrays materialized.  Collapses the per-page
-    payload to 2D (T, R) so the same kernel serves every leaf family
-    (bf16 K/V, int8 kv8 blocks, bf16 scales)."""
+    payload to one flat axis so the same kernel serves every leaf
+    family (bf16 K/V, int8 kv8 blocks, bf16 scales) whatever the
+    dense-order page tile looks like — the copy never cares about the
+    inner layout."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    P, T = pages.shape[0], pages.shape[1]
-    rest = pages.shape[2:]
+    P = pages.shape[0]
+    rest = pages.shape[1:]
     R = 1
     for d in rest:
         R *= d
     S, MP = table.shape
-    pages2 = pages.reshape(P, T, R)
+    pages2 = pages.reshape(P, R)
 
     def copy_kernel(tbl_ref, page_ref, out_ref):
-        # blocks: page_ref (1, T, R) at physical page tbl[s, p],
-        # out_ref (1, 1, T, R) at logical (s, p) — a pure DMA copy
+        # blocks: page_ref (1, R) at physical page tbl[s, p],
+        # out_ref (1, 1, R) at logical (s, p) — a pure DMA copy
         out_ref[0, 0] = page_ref[0]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(S, MP),
         in_specs=[
-            pl.BlockSpec((1, T, R), lambda s, p, tbl: (tbl[s, p], 0, 0)),
+            pl.BlockSpec((1, R), lambda s, p, tbl: (tbl[s, p], 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, T, R), lambda s, p, tbl: (s, p, 0, 0)
+            (1, 1, R), lambda s, p, tbl: (s, p, 0)
         ),
     )
     out = pl.pallas_call(
         copy_kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, MP, T, R), pages.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, MP, R), pages.dtype),
         interpret=interpret,
     )(table, pages2)
-    return out.reshape((S, MP, T) + rest)
+    return out.reshape((S, MP) + rest)

@@ -146,13 +146,20 @@ class PagePool:
     def _plan_slot_row(
         self, start_pad: int, span_end: int,
         shared: Optional[PageLease],
+        alloc_end: Optional[int] = None,
     ) -> Tuple[List[Tuple[int, str]], int]:
         """Per-page plan for a slot row: ``(page_index, kind)`` with
-        kind ∈ share/fork/alloc, plus the fork count."""
+        kind ∈ share/fork/alloc, plus the fork count.  ``alloc_end``
+        (default ``span_end``) bounds the pages allocated NOW — the
+        lazy-decode policy: pages past it stay NULL and are allocated
+        by ``extend_slot_row`` as the cursor approaches them."""
         T = self.page_tokens
+        if alloc_end is None:
+            alloc_end = span_end
+        alloc_end = min(int(alloc_end), int(span_end))
         plans: List[Tuple[int, str]] = []
         forks = 0
-        for p in range(start_pad // T, -(-span_end // T)):
+        for p in range(start_pad // T, -(-alloc_end // T)):
             ent = (
                 shared.entries[p] if shared is not None
                 and p < len(shared.entries) else None
@@ -182,11 +189,14 @@ class PagePool:
     def private_pages_needed(
         self, start_pad: int, span_end: int,
         shared: Optional[PageLease] = None,
+        alloc_end: Optional[int] = None,
     ) -> int:
         """Pages ``build_slot_row`` would actually ALLOCATE for this
         span (shared mappings cost none) — what a targeted ``reclaim``
         should free, as opposed to ``pages_needed``'s worst case."""
-        plans, _ = self._plan_slot_row(start_pad, span_end, shared)
+        plans, _ = self._plan_slot_row(
+            start_pad, span_end, shared, alloc_end
+        )
         return sum(1 for _, kind in plans if kind != "share")
 
     def build_slot_row(
@@ -194,6 +204,7 @@ class PagePool:
         start_pad: int,
         span_end: int,
         shared: Optional[PageLease] = None,
+        alloc_end: Optional[int] = None,
     ) -> Tuple[np.ndarray, np.ndarray, int]:
         """Compose a slot's table row for insert.  Returns ``(row,
         write_mask, cow_forks)``: ``row`` is the (max_pages,) int32
@@ -212,7 +223,9 @@ class PagePool:
         a leak."""
         row = np.full((self.max_pages,), NULL_PAGE, np.int32)
         mask = np.zeros((self.max_pages,), bool)
-        plans, forks = self._plan_slot_row(start_pad, span_end, shared)
+        plans, forks = self._plan_slot_row(
+            start_pad, span_end, shared, alloc_end
+        )
         n_alloc = sum(1 for _, kind in plans if kind != "share")
         fresh = self.alloc.alloc(n_alloc, cow_fork=forks)  # may raise
         fi = 0
@@ -231,6 +244,24 @@ class PagePool:
 
     def commit_slot_row(self, slot: int, row: np.ndarray) -> None:
         self.tables[slot] = row
+
+    def extend_slot_row(self, slot: int, p0: int, p1: int) -> np.ndarray:
+        """LAZY decode-page growth: allocate private pages for table
+        positions [p0, p1) of a COMMITTED slot row (they must be NULL
+        — beyond the row's allocated frontier, inside its span) and
+        return the updated row for the device-table write.
+        All-or-nothing like every other allocation: ``NoFreePages``
+        here is the mid-decode exhaustion the engine maps to a bounded
+        request failure."""
+        row = self.tables[slot]
+        for p in range(p0, p1):
+            assert row[p] == NULL_PAGE, (
+                f"lazy extend over a mapped page: slot {slot} pos {p} "
+                f"-> {row[p]}"
+            )
+        fresh = self.alloc.alloc(p1 - p0)  # may raise NoFreePages
+        row[p0:p1] = fresh
+        return row.copy()
 
     def release_row(self, row: Sequence[int]) -> None:
         """Release an UNCOMMITTED row's references (an admission that

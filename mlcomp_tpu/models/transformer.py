@@ -219,6 +219,16 @@ class SelfAttention(nn.Module):
             return self._decode_attention_quant(
                 q, k, v, kv_mask, cache_cursor
             )
+        from mlcomp_tpu.kvpool.attn import current_paged_kv
+
+        ctx = current_paged_kv()
+        if ctx is not None:
+            # FUSED paged path (engine dispatch core only): K/V live in
+            # page arrays, not cache variables — append the new rows
+            # into their pages in place and read back through the table
+            return self._paged_decode_attention(
+                ctx, q, k, v, kv_mask, cache_cursor
+            )
         b, s, _, _ = q.shape
         cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
@@ -285,6 +295,172 @@ class SelfAttention(nn.Module):
             )
         return dot_product_attention(q, k_all, v_all, mask=mask)
 
+    def _paged_decode_attention(self, ctx, q, k, v, kv_mask, cache_cursor):
+        """Fused paged decode for the bf16/f32 cache family
+        (``kvpool/attn.PagedKV`` installed by the engine's dispatch
+        core): the new K/V rows scatter into their physical pages in
+        place (table-routed — retired rows land on GRAVE), and the
+        attention reads a per-layer table gather whose bytes equal the
+        dense buffer's, so the mask math below is the cursor branch of
+        :meth:`_decode_attention` verbatim.  No dense cache variable is
+        ever created — the dense view exists only transiently inside
+        this layer's attention consumer."""
+        if cache_cursor is None:
+            raise NotImplementedError(
+                "fused paged attention runs only under the engine's "
+                "per-row-cursor decode dispatch (admission prefills "
+                "carry a dense (1, l_buf) cache)"
+            )
+        b, s, h_kv, dh = k.shape
+        prefix = "/".join(self.path)
+        k_i = ctx.index_of(prefix, "cached_key")
+        v_i = ctx.index_of(prefix, "cached_value")
+        cur = jnp.asarray(cache_cursor).astype(jnp.int32)
+        rows = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
+        pos = (
+            cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        ).reshape(-1)
+        ctx.append_rows(k_i, rows, pos, k.reshape(b * s, h_kv, dh))
+        ctx.append_rows(v_i, rows, pos, v.reshape(b * s, h_kv, dh))
+        k_all = ctx.gather_dense(k_i)          # (B, L, Hkv, dh)
+        v_all = ctx.gather_dense(v_i)
+        max_len = k_all.shape[1]
+        slots = jnp.arange(max_len, dtype=jnp.int32)
+        if s == 1:
+            mask = (slots[None, :] <= cur[:, None])[:, None, None]
+        else:  # (B, 1, S, L): per-row, per-query causal stops
+            stops = cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+            mask = (
+                slots[None, None, None, :] <= stops[:, None, :, None]
+            )
+        if kv_mask is not None:
+            mask = mask & kv_mask[:, None, None, :].astype(jnp.bool_)
+        return dot_product_attention(q, k_all, v_all, mask=mask)
+
+    def _paged_decode_attention_quant(self, ctx, q, k, v, kv_mask,
+                                      cache_cursor):
+        """Fused paged decode for the int8 KV family: quantize the new
+        rows exactly as the dense path would, scatter values AND scales
+        into their pages in place, then attend THROUGH the page table —
+        the paged Pallas kernels when the geometry keeps the dense
+        block partition (``paged_block_kv``), else a per-layer lax
+        gather feeding the DENSE kernels.  Both routes are bit-identical
+        to the dense engine: the kernels share ``_flash_block_update``
+        and the block partition; the gather is pure data movement."""
+        from mlcomp_tpu.ops.pallas.decode_attention import (
+            CHUNK_MAX_SQ,
+            decode_attention,
+            decode_attention_chunk,
+            paged_decode_attention,
+            paged_decode_attention_chunk,
+            quantize_kv,
+        )
+
+        if cache_cursor is None:
+            raise NotImplementedError(
+                "fused paged attention runs only under the engine's "
+                "per-row-cursor decode dispatch (admission prefills "
+                "carry a dense (1, l_buf) cache)"
+            )
+        b, s, hkv, dh = k.shape
+        dhp = -(-dh // 128) * 128
+        prefix = "/".join(self.path)
+        kq_i = ctx.index_of(prefix, "cached_key_q")
+        ks_i = ctx.index_of(prefix, "cached_key_scale")
+        vq_i = ctx.index_of(prefix, "cached_value_q")
+        vs_i = ctx.index_of(prefix, "cached_value_scale")
+
+        if dhp != dh:
+            kp = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+            vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+        else:
+            kp, vp = k, v
+        kq, ks_ = quantize_kv(kp)              # (B, S, Hkv, dhp) / (B, S, Hkv)
+        vq, vs_ = quantize_kv(vp)
+        cur = jnp.asarray(cache_cursor).astype(jnp.int32)
+        rows = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
+        pos = (
+            cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        ).reshape(-1)
+        sdt = ctx.spec(ks_i).dtype
+        ctx.append_rows(kq_i, rows, pos, kq.reshape(b * s, hkv, dhp))
+        ctx.append_rows(vq_i, rows, pos, vq.reshape(b * s, hkv, dhp))
+        ctx.append_rows(
+            ks_i, rows, pos, ks_.reshape(b * s, hkv, 1).astype(sdt)
+        )
+        ctx.append_rows(
+            vs_i, rows, pos, vs_.reshape(b * s, hkv, 1).astype(sdt)
+        )
+
+        if kv_mask is not None:
+            row_start = jnp.argmax(
+                kv_mask.astype(jnp.int32), axis=1
+            ).astype(jnp.int32)
+        else:
+            row_start = jnp.zeros((b,), jnp.int32)
+        qp = (
+            jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
+            if dhp != dh else q
+        )
+        scale = 1.0 / (dh**0.5)
+        if s > CHUNK_MAX_SQ:
+            # wider than the multi-query kernel (spec_k >= 32): the
+            # same XLA dequant fallback the dense path takes, on
+            # gathered bytes — degrade like dense does, never crash
+            k8 = ctx.gather_dense(kq_i)
+            ks4 = ctx.gather_dense(ks_i)
+            v8 = ctx.gather_dense(vq_i)
+            vs4 = ctx.gather_dense(vs_i)
+            l_buf = ctx.spec(kq_i).seq_len
+            k_scale = ks4.transpose(0, 1, 3, 2)      # (B, Hkv, L, 1)
+            v_scale = vs4.transpose(0, 1, 3, 2)
+            k_all = (
+                k8.astype(jnp.float32) * k_scale
+            ).astype(k.dtype).transpose(0, 2, 1, 3)[..., :dh]
+            v_all = (
+                v8.astype(jnp.float32) * v_scale
+            ).astype(v.dtype).transpose(0, 2, 1, 3)[..., :dh]
+            sl = jnp.arange(l_buf, dtype=jnp.int32)
+            stops = (cur + 1)[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+            mask = sl[None, None, None, :] < stops[:, None, :, None]
+            mask = mask & (
+                sl[None, :] >= row_start[:, None]
+            )[:, None, None, :]
+            return dot_product_attention(q, k_all, v_all, mask=mask)
+        if ctx.use_pallas_kernels(kq_i, hkv, dhp):
+            tbl = ctx.kernel_table(kq_i)
+            pages = (ctx.pages[kq_i], ctx.pages[ks_i],
+                     ctx.pages[vq_i], ctx.pages[vs_i])
+            if s == 1:
+                out = paged_decode_attention(
+                    qp[:, 0], *pages, tbl, kv_start=row_start,
+                    kv_stop=cur + 1, scale=scale,
+                )
+                return out[..., :dh][:, None]
+            out = paged_decode_attention_chunk(
+                qp, *pages, tbl, kv_start=row_start, kv_stop0=cur + 1,
+                scale=scale,
+            )
+            return out[..., :dh]
+        # gather fallback (geometry cannot keep the dense block
+        # partition): per-layer lax reads feeding the DENSE kernels —
+        # same bytes, same math, still no carried dense view
+        k8 = ctx.gather_dense(kq_i)
+        ks4 = ctx.gather_dense(ks_i)
+        v8 = ctx.gather_dense(vq_i)
+        vs4 = ctx.gather_dense(vs_i)
+        if s == 1:
+            out = decode_attention(
+                qp[:, 0], k8, ks4, v8, vs4, kv_start=row_start,
+                kv_stop=cur + 1, scale=scale,
+            )
+            return out[..., :dh][:, None]
+        out = decode_attention_chunk(
+            qp, k8, ks4, v8, vs4, kv_start=row_start, kv_stop0=cur + 1,
+            scale=scale,
+        )
+        return out[..., :dh]
+
     def _decode_attention_quant(self, q, k, v, kv_mask, cache_cursor=None):
         """int8 KV-cache decode (``kv_quant=True``).
 
@@ -310,11 +486,21 @@ class SelfAttention(nn.Module):
         path); wider chunks and mesh serving dequantize the buffer in
         XLA — correct, bandwidth-amortized at prefill widths.
         """
+        from mlcomp_tpu.kvpool.attn import current_paged_kv
         from mlcomp_tpu.ops.pallas.decode_attention import (
             decode_attention,
             pick_buffer_len,
             quantize_kv,
         )
+
+        ctx = current_paged_kv()
+        if ctx is not None:
+            # FUSED paged path (engine dispatch core only): no dense
+            # cache variables — pages, table-routed writes, and the
+            # paged kernel family replace the buffers below
+            return self._paged_decode_attention_quant(
+                ctx, q, k, v, kv_mask, cache_cursor
+            )
 
         b, s, hkv, dh = k.shape
         dhp = -(-dh // 128) * 128

@@ -117,6 +117,58 @@ def quantize_kv(x: jax.Array, eps: float = 1e-8) -> Tuple[jax.Array, jax.Array]:
     return q, scale
 
 
+def _flash_block_update(
+    q, k, ks, v, vs, mask_fn, scale, acc_ref, m_ref, l_ref,
+):
+    """ONE online-softmax block update — the arithmetic core every
+    kernel in this family (dense single-token, dense multi-query, and
+    their PAGED twins) shares.  Factoring it is what makes the paged
+    kernels bit-identical to the dense ones BY CONSTRUCTION: same ops,
+    same shapes, same accumulation order — only where the K/V block's
+    bytes came from differs (BlockSpec copy vs table-driven page DMA).
+
+    ``mask_fn(shape)`` returns the valid-column mask for the (Hkv,
+    rows, BLK) logit block; masked columns go to NEG_INF before the
+    running max, so garbage bytes in skipped/out-of-window positions
+    (uncopied pages in the paged kernels, not-yet-written slots in the
+    dense ones) never reach the softmax."""
+    # one batched dot over all KV heads: few fat grid steps beat
+    # many thin ones (per-step overhead dominated the first cut)
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale                                   # (Hkv, rows, BLK)
+    # K dequant on the logits; scales may be stored bf16 (round 5:
+    # halves the scale-cache write stream) — cast in VMEM
+    s = s * ks.astype(jnp.float32)
+    s = jnp.where(mask_fn(s.shape), s, NEG_INF)
+
+    m_prev = m_ref[:, :, :1]
+    l_prev = l_ref[:, :, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # fully-masked-so-far rows keep exact zeros (exp(NEG_INF - NEG_INF)
+    # would be 1): same guard as the bounded flash path
+    p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    pv = (p * vs.astype(jnp.float32)).astype(q.dtype)
+    # ^ V dequant on the probs (bf16 scale cast like K's)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        pv, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _flash_finalize(o_ref, acc_ref, l_ref):
+    l = l_ref[:, :, :1]
+    o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+        o_ref.dtype
+    )
+
+
 def _kernel(
     start_ref, stop_ref,  # scalar prefetch: (B,) int32 each
     q_ref, k_ref, ks_ref, v_ref, vs_ref,
@@ -138,47 +190,22 @@ def _kernel(
     hi = stop_ref[b]
     live = (j * block_kv < hi) & ((j + 1) * block_kv > lo)
 
+    def mask_fn(shape):
+        cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+        return (cols >= lo) & (cols < hi)
+
     @pl.when(live)
     def _step():
         q = q_ref[0]                               # (Hkv, Gp, dh)
-        k = k_ref[0].astype(q.dtype)               # (Hkv, BLK, dh), VMEM dequant
-        # one batched dot over all KV heads: few fat grid steps beat
-        # many thin ones (per-step overhead dominated the first cut)
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale                                   # (Hkv, Gp, BLK)
-        # K dequant on the logits; scales may be stored bf16 (round 5:
-        # halves the scale-cache write stream) — cast in VMEM
-        s = s * ks_ref[0].astype(jnp.float32)
-        cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where((cols >= lo) & (cols < hi), s, NEG_INF)
-
-        m_prev = m_ref[:, :, :1]
-        l_prev = l_ref[:, :, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        # fully-masked-so-far rows keep exact zeros (exp(NEG_INF - NEG_INF)
-        # would be 1): same guard as the bounded flash path
-        p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = jnp.broadcast_to(
-            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
-        )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        pv = (p * vs_ref[0].astype(jnp.float32)).astype(q.dtype)
-        # ^ V dequant on the probs (bf16 scale cast like K's)
-        v = v_ref[0].astype(q.dtype)                # (Hkv, BLK, dh)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            pv, v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
+        _flash_block_update(
+            q, k_ref[0].astype(q.dtype), ks_ref[0],
+            v_ref[0].astype(q.dtype), vs_ref[0],
+            mask_fn, scale, acc_ref, m_ref, l_ref,
         )
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = l_ref[:, :, :1]
-        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
-            o_ref.dtype
-        )
+        _flash_finalize(o_ref, acc_ref, l_ref)
 
 
 def decode_attention(
@@ -323,49 +350,31 @@ def _kernel_chunk(
     hi_max = stop0 + (s_q - 1)
     live = (j * block_kv < hi_max) & ((j + 1) * block_kv > lo)
 
-    @pl.when(live)
-    def _step():
-        q = q_ref[0]                               # (Hkv, Sp, dh)
-        k = k_ref[0].astype(q.dtype)               # (Hkv, BLK, dh)
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale                                   # (Hkv, Sp, BLK)
-        s = s * ks_ref[0].astype(jnp.float32)
-        cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    def mask_fn(shape):
+        cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 2)
         # per-sublane-row causal stop: row r is query r // rep.  Pad
         # rows beyond s_q*rep CLAMP to the last query's window — they
         # compute (zero-vector queries) and their output is sliced
         # away by the caller; the clamp keeps their window inside the
         # live range so nothing depends on pad-row masking
         qrow = jnp.minimum(
-            jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // rep,
+            jax.lax.broadcasted_iota(jnp.int32, shape, 1) // rep,
             s_q - 1,
         )
-        s = jnp.where((cols >= lo) & (cols < stop0 + qrow), s, NEG_INF)
+        return (cols >= lo) & (cols < stop0 + qrow)
 
-        m_prev = m_ref[:, :, :1]
-        l_prev = l_ref[:, :, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = jnp.broadcast_to(
-            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
-        )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        pv = (p * vs_ref[0].astype(jnp.float32)).astype(q.dtype)
-        v = v_ref[0].astype(q.dtype)                # (Hkv, BLK, dh)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            pv, v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]                               # (Hkv, Sp, dh)
+        _flash_block_update(
+            q, k_ref[0].astype(q.dtype), ks_ref[0],
+            v_ref[0].astype(q.dtype), vs_ref[0],
+            mask_fn, scale, acc_ref, m_ref, l_ref,
         )
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = l_ref[:, :, :1]
-        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
-            o_ref.dtype
-        )
+        _flash_finalize(o_ref, acc_ref, l_ref)
 
 
 # sublane budget for the multi-query kernel's (Hkv, Sp, dh) f32
@@ -484,6 +493,408 @@ def decode_attention_chunk(
         out_shape=jax.ShapeDtypeStruct((b, h_kv, sp, dh), q.dtype),
         interpret=interpret,
     )(start, stop0, qg, k8, ks, v8, vs)
+    out = out[:, :, :rows].reshape(b, h_kv, s_q, rep, dh)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, dh)
+
+
+# ---------------------------------------------------------------- paged
+#
+# The PAGED twins of the two kernels above (mlcomp_tpu/kvpool): K/V
+# live in (num_pages, Hkv, T, dh) page arrays addressed through a
+# per-slot page table, and the kernels read them THROUGH the table —
+# the table rides the scalar prefetch, and each grid step DMAs its
+# block's pages straight from the pool arrays in HBM into VMEM
+# scratch (the block-index-from-prefetched-table idiom the kvpool
+# gather kernel proved, fused into the attention consumer).  No dense
+# (slots, l_buf, ...) view ever materializes: the dense round trip the
+# PR-7 sandwich paid (~2x the live slots' KV bytes per dispatch as
+# pure data movement) is gone, and the kernel moves only the pages the
+# window actually covers.
+#
+# Bit-equality with the dense kernels is BY CONSTRUCTION: the grid and
+# block partition are the DENSE kernel's (auto_block_kv over the leaf
+# buffer — pages are assembled into the same fat blocks, so the online
+# softmax visits columns in the same order), and the arithmetic is the
+# shared _flash_block_update.  Eligibility is therefore geometric: the
+# dense block size must be a whole number of pages
+# (paged_block_kv(...) is not None); other geometries take the lax
+# gather-then-dense-kernel reference, which is equally exact.
+#
+# NULL pages (unmapped: left-pad prefix, beyond-span tail, not-yet-
+# lazily-allocated decode pages) are pl.when-skipped like out-of-range
+# blocks: their DMA never issues, the scratch keeps stale bytes, and
+# the column mask removes them before the softmax.  GRAVE pages
+# (retired rows' write sink) are only ever inside a DEAD row's window,
+# whose output nothing reads — same contract as the dense kernel over
+# a retired row's stale buffer.
+
+
+def paged_block_kv(l_buf: int, h_kv: int, dh: int,
+                   page_tokens: int) -> Optional[int]:
+    """The dense kernel's block size for this geometry IF it is a
+    whole number of pages (the paged kernels' eligibility gate), else
+    None — callers fall back to the lax gather + dense kernel."""
+    blk = auto_block_kv(l_buf, h_kv, dh)
+    if l_buf % page_tokens == 0 and blk % page_tokens == 0:
+        return blk
+    return None
+
+
+def _fetch_block_pages(
+    tbl_ref, b, j, lo, hi, sem,
+    kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+    k_buf, ks_buf, v_buf, vs_buf,
+    *, page_tokens: int, pages_per_block: int, null_page: int,
+):
+    """DMA block ``j``'s pages from the HBM pool arrays into the VMEM
+    block scratch, table-driven.  Pages wholly outside [lo, hi) — and
+    NULL pages — are skipped: no copy issues, and the stale scratch
+    bytes land on columns the mask removes before the softmax.
+
+    A ``fori_loop`` (one traced body) rather than a Python unroll:
+    pages_per_block can run into the dozens at small page sizes, and
+    an unrolled body that size multiplies COMPILE time per kernel —
+    measured ~25% on the engine's CPU-interpret test matrix — for no
+    runtime difference (the copies are serial either way; overlapping
+    them is the roofline follow-up)."""
+    T = page_tokens
+
+    def body(p, _):
+        col = j * pages_per_block + p
+        pid = tbl_ref[b, col]
+        t0 = col * T
+        use = (t0 < hi) & (t0 + T > lo) & (pid != null_page)
+
+        @pl.when(use)
+        def _copy():
+            # K/V pages are dense-layout tiles (Hkv, T, dh): they drop
+            # into the block's sublane slice with no transpose
+            for src, dst in ((kq_hbm, k_buf), (vq_hbm, v_buf)):
+                cp = pltpu.make_async_copy(
+                    src.at[pid], dst.at[:, pl.ds(p * T, T), :], sem
+                )
+                cp.start()
+                cp.wait()
+            for src, dst in ((ks_hbm, ks_buf), (vs_hbm, vs_buf)):
+                cp = pltpu.make_async_copy(
+                    src.at[pid], dst.at[:, :, pl.ds(p * T, T)], sem
+                )
+                cp.start()
+                cp.wait()
+
+        @pl.when(~use)
+        def _blank():
+            # a skipped page's K/V garbage is masked before the softmax
+            # (int8 bytes are always finite), but SCALE garbage can be
+            # a NaN bit pattern — and 0 * NaN would poison the p@V
+            # accumulator straight through the mask.  Zero the scale
+            # slices so skipped columns contribute exactly the dense
+            # kernel's nothing (p is exactly 0 there).
+            ks_buf[:, :, pl.ds(p * T, T)] = jnp.zeros(
+                (ks_buf.shape[0], 1, T), ks_buf.dtype
+            )
+            vs_buf[:, :, pl.ds(p * T, T)] = jnp.zeros(
+                (vs_buf.shape[0], 1, T), vs_buf.dtype
+            )
+
+        return _
+
+    jax.lax.fori_loop(0, pages_per_block, body, 0)
+
+
+def _paged_kernel(
+    start_ref, stop_ref, tbl_ref,  # scalar prefetch
+    q_ref, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+    o_ref,
+    k_buf, ks_buf, v_buf, vs_buf,
+    acc_ref, m_ref, l_ref, sem,
+    *, scale: float, block_kv: int, page_tokens: int,
+    pages_per_block: int, null_page: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    lo = start_ref[b]
+    hi = stop_ref[b]
+    live = (j * block_kv < hi) & ((j + 1) * block_kv > lo)
+
+    def mask_fn(shape):
+        cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+        return (cols >= lo) & (cols < hi)
+
+    @pl.when(live)
+    def _step():
+        _fetch_block_pages(
+            tbl_ref, b, j, lo, hi, sem,
+            kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+            k_buf, ks_buf, v_buf, vs_buf,
+            page_tokens=page_tokens, pages_per_block=pages_per_block,
+            null_page=null_page,
+        )
+        q = q_ref[0]                               # (Hkv, Gp, dh)
+        _flash_block_update(
+            q, k_buf[:].astype(q.dtype), ks_buf[:],
+            v_buf[:].astype(q.dtype), vs_buf[:],
+            mask_fn, scale, acc_ref, m_ref, l_ref,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        _flash_finalize(o_ref, acc_ref, l_ref)
+
+
+def _paged_call(
+    kernel, q, kq_pages, ks_pages, vq_pages, vs_pages, table,
+    start, stop, interpret: bool,
+):
+    """Shared pallas_call plumbing for the two paged kernels: grid
+    (B, nk) over dense-sized blocks, table prefetched as the third
+    scalar, page arrays pinned in HBM (ANY), block scratch + online
+    state in VMEM."""
+    from mlcomp_tpu.kvpool.allocator import NULL_PAGE
+
+    b = q.shape[0]
+    _, h_kv, T, dh = kq_pages.shape
+    mp = table.shape[1]
+    l_buf = mp * T
+    blk = paged_block_kv(l_buf, h_kv, dh, T)
+    if blk is None:
+        raise NotImplementedError(
+            f"paged attention needs the dense block size "
+            f"({auto_block_kv(l_buf, h_kv, dh)}) to be a whole number "
+            f"of {T}-token pages over the {l_buf}-slot buffer; this "
+            "geometry takes the lax gather path"
+        )
+    nk = l_buf // blk
+    sp = q.shape[2]
+    return pl.pallas_call(
+        functools.partial(
+            kernel, block_kv=blk, page_tokens=T,
+            pages_per_block=blk // T, null_page=NULL_PAGE,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, nk),
+            in_specs=[
+                pl.BlockSpec((1, h_kv, sp, dh),
+                             lambda b_, j, *_: (b_, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, h_kv, sp, dh), lambda b_, j, *_: (b_, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((h_kv, blk, dh), kq_pages.dtype),
+                pltpu.VMEM((h_kv, 1, blk), ks_pages.dtype),
+                pltpu.VMEM((h_kv, blk, dh), vq_pages.dtype),
+                pltpu.VMEM((h_kv, 1, blk), vs_pages.dtype),
+                pltpu.VMEM((h_kv, sp, dh), jnp.float32),
+                pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
+                pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, sp, dh), q.dtype),
+        interpret=interpret,
+    )(start, stop, table, q, kq_pages, ks_pages, vq_pages, vs_pages)
+
+
+def _check_paged_operands(h, kq_pages, ks_pages, vq_pages,
+                          vs_pages, table):
+    p_, h_kv, T, dh = kq_pages.shape
+    if vq_pages.shape != kq_pages.shape:
+        raise ValueError(
+            f"K/V page shapes differ: {kq_pages.shape} vs {vq_pages.shape}"
+        )
+    want = (p_, h_kv, 1, T)
+    if ks_pages.shape != want or vs_pages.shape != want:
+        raise ValueError(
+            f"scale pages must be {want}; got ks {ks_pages.shape}, "
+            f"vs {vs_pages.shape}"
+        )
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    if dh % LANES:
+        raise NotImplementedError(
+            f"head dim {dh} must be a multiple of {LANES} "
+            "(allocator contract)"
+        )
+    if table.ndim != 2:
+        raise ValueError(f"table must be (B, MP); got {table.shape}")
+    return h_kv, T, dh
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    kq_pages: jax.Array,
+    ks_pages: jax.Array,
+    vq_pages: jax.Array,
+    vs_pages: jax.Array,
+    table: jax.Array,
+    kv_start: Optional[jax.Array] = None,
+    kv_stop: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """:func:`decode_attention` reading the int8 KV cache THROUGH a
+    page table: q (B, H, dh); kq/vq pages (P, Hkv, T, dh) int8; ks/vs
+    pages (P, Hkv, 1, T); ``table`` (B, MP) int32 maps row b's logical
+    page j to a physical page (MP * T must equal the leaf buffer
+    length, lane-aligned like the dense kernel's).  Windows and output
+    exactly as the dense kernel — bit-identical on the same cache
+    bytes (shared block partition + shared arithmetic)."""
+    b, h, dh_q = q.shape
+    h_kv, T, dh = _check_paged_operands(
+        h, kq_pages, ks_pages, vq_pages, vs_pages, table
+    )
+    if dh_q != dh:
+        raise ValueError(f"q head dim {dh_q} != page head dim {dh}")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    l_buf = table.shape[1] * T
+    scale = scale if scale is not None else 1.0 / (dh**0.5)
+
+    rep = h // h_kv
+    gp = max(SUBLANES, -(-rep // SUBLANES) * SUBLANES)
+    qg = q.reshape(b, h_kv, rep, dh)
+    if gp != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - rep), (0, 0)))
+
+    start = (
+        jnp.zeros((b,), jnp.int32) if kv_start is None
+        else kv_start.astype(jnp.int32)
+    )
+    stop = (
+        jnp.full((b,), l_buf, jnp.int32) if kv_stop is None
+        else jnp.broadcast_to(kv_stop, (b,)).astype(jnp.int32)
+    )
+    out = _paged_call(
+        functools.partial(_paged_kernel, scale=scale),
+        qg, kq_pages, ks_pages, vq_pages, vs_pages,
+        table.astype(jnp.int32), start, stop, interpret,
+    )
+    return out[:, :, :rep].reshape(b, h, dh)
+
+
+def _paged_kernel_chunk(
+    start_ref, stop0_ref, tbl_ref,  # scalar prefetch
+    q_ref, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+    o_ref,
+    k_buf, ks_buf, v_buf, vs_buf,
+    acc_ref, m_ref, l_ref, sem,
+    *, scale: float, block_kv: int, page_tokens: int,
+    pages_per_block: int, null_page: int, rep: int, s_q: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    lo = start_ref[b]
+    stop0 = stop0_ref[b]
+    hi_max = stop0 + (s_q - 1)
+    live = (j * block_kv < hi_max) & ((j + 1) * block_kv > lo)
+
+    def mask_fn(shape):
+        cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+        qrow = jnp.minimum(
+            jax.lax.broadcasted_iota(jnp.int32, shape, 1) // rep,
+            s_q - 1,
+        )
+        return (cols >= lo) & (cols < stop0 + qrow)
+
+    @pl.when(live)
+    def _step():
+        _fetch_block_pages(
+            tbl_ref, b, j, lo, hi_max, sem,
+            kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+            k_buf, ks_buf, v_buf, vs_buf,
+            page_tokens=page_tokens, pages_per_block=pages_per_block,
+            null_page=null_page,
+        )
+        q = q_ref[0]                               # (Hkv, Sp, dh)
+        _flash_block_update(
+            q, k_buf[:].astype(q.dtype), ks_buf[:],
+            v_buf[:].astype(q.dtype), vs_buf[:],
+            mask_fn, scale, acc_ref, m_ref, l_ref,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        _flash_finalize(o_ref, acc_ref, l_ref)
+
+
+def paged_decode_attention_chunk(
+    q: jax.Array,
+    kq_pages: jax.Array,
+    ks_pages: jax.Array,
+    vq_pages: jax.Array,
+    vs_pages: jax.Array,
+    table: jax.Array,
+    kv_start: Optional[jax.Array] = None,
+    kv_stop0: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """:func:`decode_attention_chunk` through a page table: S chunk
+    queries per row, ONE table-driven sweep of the paged cache (the
+    speculative-verify shape).  q (B, S, H, dh); pages/table as
+    :func:`paged_decode_attention`; per-row causal stops
+    [kv_start, kv_stop0 + j) like the dense chunk kernel."""
+    b, s_q, h, dh_q = q.shape
+    h_kv, T, dh = _check_paged_operands(
+        h, kq_pages, ks_pages, vq_pages, vs_pages, table
+    )
+    if dh_q != dh:
+        raise ValueError(f"q head dim {dh_q} != page head dim {dh}")
+    if s_q > CHUNK_MAX_SQ:
+        raise NotImplementedError(
+            f"chunk width {s_q} > {CHUNK_MAX_SQ}: the multi-query kernel "
+            "is sized for verify/small-chunk shapes; wider chunks take "
+            "the XLA dequant path"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    l_buf = table.shape[1] * T
+    scale = scale if scale is not None else 1.0 / (dh**0.5)
+
+    rep = h // h_kv
+    rows = s_q * rep
+    sp = max(SUBLANES, -(-rows // SUBLANES) * SUBLANES)
+    qg = q.reshape(b, s_q, h_kv, rep, dh).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, h_kv, rows, dh)
+    if sp != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, sp - rows), (0, 0)))
+
+    start = (
+        jnp.zeros((b,), jnp.int32) if kv_start is None
+        else kv_start.astype(jnp.int32)
+    )
+    stop0 = (
+        jnp.full((b,), l_buf - s_q + 1, jnp.int32) if kv_stop0 is None
+        else jnp.broadcast_to(kv_stop0, (b,)).astype(jnp.int32)
+    )
+    out = _paged_call(
+        functools.partial(_paged_kernel_chunk, scale=scale, rep=rep,
+                          s_q=s_q),
+        qg, kq_pages, ks_pages, vq_pages, vs_pages,
+        table.astype(jnp.int32), start, stop0, interpret,
+    )
     out = out[:, :, :rows].reshape(b, h_kv, s_q, rep, dh)
     return out.transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, dh)
 

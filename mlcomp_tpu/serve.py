@@ -703,37 +703,43 @@ class GenerationService:
 
     def _page_budget_check(self, ids, n_new: int) -> None:
         """Free-page admission gate (paged layout, always on): the
-        request's WORST-case page need against what is free plus
-        reclaimable minus the queued backlog's own worst-case needs —
-        pages commit only at insert, so without the backlog term a
-        flood would all pass the same free-page reading and queue
-        unboundedly.  Approximate like the other caps (racing submits
-        may both pass); the engine's own boundary gate defers or fails
+        request's INITIAL page need — prefill span plus one dispatch
+        of decode lookahead, the lazy-allocation admission currency —
+        against what is free plus reclaimable minus the queued
+        backlog's own initial needs.  Pages commit only at insert, so
+        without the backlog term a flood would all pass the same
+        free-page reading and queue unboundedly.  Decode pages past
+        the lookahead allocate lazily as cursors cross page boundaries
+        (that overcommit is why paged admits strictly more concurrent
+        streams at equal HBM); a pool that runs dry at such a crossing
+        is the engine's BOUNDED mid-stream failure, not this gate's
+        concern.  Approximate like the other caps (racing submits may
+        both pass); the engine's own boundary gate defers or fails
         whatever slips through."""
         eng = self.engine
         try:
-            need = eng._pages_worst({"ids": ids, "n_new": n_new})
+            need = eng._pages_initial({"ids": ids, "n_new": n_new})
             pool = eng._pool
             avail = pool.alloc.free_pages + pool.reclaimable_pages()
             backlog = 0
             for r in list(eng._pending):
-                backlog += eng._pages_worst(r)
+                backlog += eng._pages_initial(r)
             with eng._queue.mutex:
                 parked = [
                     r for r in eng._queue.queue if isinstance(r, dict)
                 ]
             for r in parked:
-                backlog += eng._pages_worst(r)
+                backlog += eng._pages_initial(r)
             adm = eng._adm
             if adm is not None:
-                backlog += eng._pages_worst(adm.req)
+                backlog += eng._pages_initial(adm.req)
         except RuntimeError:
             return  # torn read mid-mutation: admit, the engine re-gates
         if need <= avail - backlog:
             return
         self._reject(
             "no_free_pages",
-            f"request needs {need} KV pages worst-case; "
+            f"request needs {need} KV pages at admission; "
             f"{max(avail - backlog, 0)} free after the queued backlog "
             f"(pool: {pool.alloc.total_pages})",
             needed_pages=need + backlog,

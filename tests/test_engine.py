@@ -17,6 +17,19 @@ from mlcomp_tpu.serve import GenerationService
 from mlcomp_tpu.train.state import init_model
 
 
+# shared compiled-program pools per engine config (the _fns idiom
+# from tests/test_engine_fused_admit.py, in-place variant): engines
+# with identical geometry compile their dispatch/prefill/insert
+# families once for the whole module — pipeline depth and host knobs
+# never change the programs
+_FNS: dict = {}
+
+
+def _pooled(eng, *key):
+    eng._fns = _FNS.setdefault(key, eng._fns)
+    return eng
+
+
 def _model_and_params(kv_quant=False, seed=0):
     model = create_model({
         "name": "transformer_lm", "vocab_size": 64, "hidden": 64,
@@ -70,10 +83,11 @@ def test_engine_mid_decode_join_and_no_starvation():
     the depth-2 bound (one extra in-flight dispatch) lives in
     test_engine_pipeline.py."""
     model, params = _model_and_params()
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16,), max_new_cap=16,
-                       steps_per_dispatch=1, pipeline_depth=1,
-                       fused_admission=False)
+    eng = _pooled(DecodeEngine(model, {"params": params}, slots=2,
+                                prompt_buckets=(16,), max_new_cap=16,
+                                steps_per_dispatch=1, pipeline_depth=1,
+                                fused_admission=False),
+                  "s2b16c16k1")
     try:
         qa: "queue.Queue" = queue.Queue()
         fa = eng.submit([3, 14, 15, 9, 2], 12, stream=qa)
@@ -102,8 +116,9 @@ def test_engine_mid_decode_join_and_no_starvation():
 
 def test_engine_streaming_order_and_final_result():
     model, params = _model_and_params()
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16,), max_new_cap=8)
+    eng = _pooled(DecodeEngine(model, {"params": params}, slots=2,
+                                prompt_buckets=(16,), max_new_cap=8),
+                  "s2b16c8")
     try:
         q: "queue.Queue" = queue.Queue()
         fut = eng.submit([5, 6, 7], 5, logprobs=True, stream=q)
@@ -125,8 +140,9 @@ def test_engine_streaming_order_and_final_result():
 
 def test_engine_eos_and_repetition_penalty_match_generate():
     model, params = _model_and_params()
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16,), max_new_cap=8)
+    eng = _pooled(DecodeEngine(model, {"params": params}, slots=2,
+                                prompt_buckets=(16,), max_new_cap=8),
+                  "s2b16c8")
     try:
         ids = [3, 14, 15, 9, 2]
         # greedy with repetition penalty == generate's rowwise-rp path
@@ -212,8 +228,9 @@ def test_service_defaults_to_continuous_and_streams_http():
 
 def test_engine_validation_and_service_window_stream_refusal():
     model, params = _model_and_params()
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16,), max_new_cap=8)
+    eng = _pooled(DecodeEngine(model, {"params": params}, slots=2,
+                                prompt_buckets=(16,), max_new_cap=8),
+                  "s2b16c8")
     try:
         with pytest.raises(ValueError, match="non-empty"):
             eng.submit([], 4)
@@ -403,9 +420,10 @@ def test_engine_close_under_load_and_wedged_abandon():
     import time as _t
 
     model, params = _model_and_params()
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16,), max_new_cap=16,
-                       steps_per_dispatch=1)
+    eng = _pooled(DecodeEngine(model, {"params": params}, slots=2,
+                                prompt_buckets=(16,), max_new_cap=16,
+                                steps_per_dispatch=1),
+                  "s2b16c16k1")
     futs = [eng.submit([3, 14, 15, 9, 2], 16) for _ in range(4)]
     eng.close()  # mid-decode: 2 active rows + 2 queued
     assert not eng._thread.is_alive()
@@ -419,9 +437,10 @@ def test_engine_close_under_load_and_wedged_abandon():
         eng.submit([1], 2)
 
     # wedged dispatch: swap the compiled dispatch fn for a sleeper
-    eng2 = DecodeEngine(model, {"params": params}, slots=2,
-                        prompt_buckets=(16,), max_new_cap=16,
-                        steps_per_dispatch=1)
+    eng2 = _pooled(DecodeEngine(model, {"params": params}, slots=2,
+                                 prompt_buckets=(16,), max_new_cap=16,
+                                 steps_per_dispatch=1),
+                   "s2b16c16k1")
     eng2.submit([3, 14, 15, 9, 2], 4).result(timeout=300)  # warm
     real = eng2._dispatch_fn()
     release = threading.Event()

@@ -7,6 +7,7 @@ prefix-registry COW path — while admission is gated by free pages,
 the slot count scales elastically, and nothing leaks a page."""
 
 import functools
+import os
 import queue
 import time
 import warnings
@@ -77,9 +78,10 @@ def _overlapped(layout, kv_quant=False, depth=2, spec_k=None):
     kw = {}
     if spec_k is not None:
         kw = {"spec_k": spec_k, "steps_per_dispatch": 1}
-    # the dispatch family closes over spec_k — keep spec and scan
-    # engines in separate compiled-program pools
-    eng = _engine(layout, kv_quant, fns_key=("mtx", spec_k),
+    # the dispatch family closes over spec_k AND the paged data path
+    # (fused vs lax sandwich) — keep each in its own compiled pool
+    attn = os.environ.get("MLCOMP_TPU_PAGED_ATTN", "auto")
+    eng = _engine(layout, kv_quant, fns_key=("mtx", spec_k, attn),
                   pipeline_depth=depth, **kw)
     try:
         qa: "queue.Queue" = queue.Queue()
@@ -97,19 +99,47 @@ def _overlapped(layout, kv_quant=False, depth=2, spec_k=None):
 @pytest.mark.parametrize("kv_quant", [False, True])
 @pytest.mark.parametrize("depth", [1, 2])
 def test_paged_bit_identical_to_dense(kv_quant, depth):
+    """The default paged data path is FUSED (MLCOMP_TPU_PAGED_ATTN
+    auto): attention reads K/V through the page table (paged Pallas
+    kernels on the kv8 family, per-layer gathers on f32) and the
+    per-token append writes pages in place — no dense view, and still
+    bit-identical to the dense engine.  The 10-token decode budget
+    also crosses the insert's one-dispatch lookahead, so decode pages
+    allocate LAZILY mid-stream (counted, never starved here)."""
     dense, _ = _overlapped("dense", kv_quant, depth=depth)
     paged, st = _overlapped("paged", kv_quant, depth=depth)
     assert paged == dense
     assert st["kv_layout"] == "paged"
     assert st["kv_pool"]["pages_total"] > 0
+    assert st["kv_pages_lazy_allocated"] > 0
+    assert st["kv_decode_page_failures"] == 0
 
 
 def test_paged_bit_identical_spec_dispatch():
-    """The speculative verify (draft + K+1-wide forward) runs the same
-    core through the page gather/scatter sandwich."""
+    """The speculative verify (draft + K+1-wide forward) runs fused
+    too: the multi-query PAGED kernel sweeps the table-mapped pages
+    once for all K+1 positions."""
     dense, _ = _overlapped("dense", spec_k=3)
     paged, _ = _overlapped("paged", spec_k=3)
     assert paged == dense
+
+
+def test_fused_matches_lax_reference(monkeypatch):
+    """MLCOMP_TPU_PAGED_ATTN=lax keeps the PR-7 gather/scatter
+    sandwich as the everywhere-reference; the fused default must emit
+    the same tokens AND logprobs on the kv8 family (the matrix above
+    already pins fused == dense; this pins the reference path too, so
+    a bisect between the two envs always means something)."""
+    fused, _ = _overlapped("paged", True, depth=2)
+    monkeypatch.setenv("MLCOMP_TPU_PAGED_ATTN", "lax")
+    # _overlapped keys the shared compiled-program pool on the env, so
+    # the reference engine compiles its own sandwich family instead of
+    # silently reusing the fused programs
+    ref, st = _overlapped("paged", True, depth=2)
+    assert ref == fused
+    assert st["kv_pages_lazy_allocated"] > 0  # lazy growth is
+    # data-path-independent: the sandwich scatters through the same
+    # lazily-extended tables
 
 
 def test_registry_cow_hit_bit_identical():
@@ -179,21 +209,32 @@ def test_elastic_scaling_grows_and_shrinks():
 
 
 def test_admission_defers_then_completes_when_pages_free():
-    """A pool sized for ONE worst-case request: the second submit
-    DEFERS at the boundary gate (no fail) and completes after the
-    first retires — FIFO preserved, zero leaks."""
+    """Lazy-admission deferral: the gate budgets INITIAL pages
+    (prefill + one dispatch of lookahead), so a second request whose
+    initial need exceeds what the first leaves free DEFERS at the
+    boundary (no fail, FIFO preserved) and completes after the first
+    retires — and the first can still grow its lazily-deferred decode
+    pages while it is alone.  Zero leaks at quiesce."""
+    # B fills its 16-bucket (15 real tokens -> 1 pad slot): its initial
+    # need alone exceeds what remains while A (worst case smaller but
+    # admitted first) is live in a floor-sized pool
+    ids_b15 = [7, 3, 44, 5, 6, 9, 2, 41, 8, 30, 31, 32, 33, 34, 35]
     eng = _engine("paged", slots=2, prefill_chunk=8, max_slots=2)
-    need = eng._pages_worst({"ids": IDS_A, "n_new": 6})
     one_max = eng._layout.max_pages  # constructor floor: 1 worst case
+    need_a = eng._pages_worst({"ids": IDS_A, "n_new": 6})
+    need_b0 = eng._pages_initial({"ids": ids_b15, "n_new": 6})
     _close(eng)
+    pool_pages = max(need_a, one_max)
+    assert need_b0 > pool_pages - need_a  # geometry: B must defer
     eng = _engine("paged", slots=2, prefill_chunk=8, max_slots=2,
-                  kv_pages=RESERVED_PAGES + max(need, one_max))
+                  kv_pages=RESERVED_PAGES + pool_pages)
     try:
         f1 = eng.submit(IDS_A, 6)
-        f2 = eng.submit(IDS_B, 6)
+        f2 = eng.submit(ids_b15, 6)
         r1 = f1.result(timeout=300)
         r2 = f2.result(timeout=300)
         assert len(r1["ids"]) == 6 and len(r2["ids"]) == 6
+        assert eng.stats()["kv_decode_page_failures"] == 0
         pool = eng._pool
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < 10:

@@ -4,6 +4,7 @@ layouts, mixed knobs, mid-stream admission and EOS mid-dispatch;
 close/submit races with a dispatch in flight; knob rejection; and the
 overlap/latency metrics in stats()."""
 
+import functools
 import queue
 
 import jax
@@ -18,6 +19,28 @@ from mlcomp_tpu.serve import GenerationService
 from mlcomp_tpu.train.state import init_model
 
 
+# compiled-program pool per engine config (the _fns idiom from
+# tests/test_engine_fused_admit.py): pipeline depth is HOST-side only,
+# so the depth-1 and depth-2 arms of every equality pair share the
+# same jitted dispatch/prefill/insert programs — compile once per
+# (kv_quant, config) instead of once per engine
+_FNS: dict = {}
+
+
+def _share(eng, key):
+    pool = _FNS.setdefault(key, {})
+    eng._fns.update(pool)
+    eng._fns_pool = pool
+    return eng
+
+
+def _close(eng):
+    if hasattr(eng, "_fns_pool"):
+        eng._fns_pool.update(eng._fns)
+    eng.close()
+
+
+@functools.lru_cache(maxsize=None)
 def _model_and_params(kv_quant=False, seed=0):
     model = create_model({
         "name": "transformer_lm", "vocab_size": 64, "hidden": 64,
@@ -55,9 +78,12 @@ def _mixed_workload(model, params, depth, kv_quant):
     # EOS mid-dispatch: C stops at its first greedy token, i.e. inside
     # step 1 of a K=2 dispatch (deterministic: greedy reference)
     eos_c = _reference(model, params, ids_c, 1, bucket=16)[0]
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16, 32), max_new_cap=12,
-                       steps_per_dispatch=2, pipeline_depth=depth)
+    eng = _share(
+        DecodeEngine(model, {"params": params}, slots=2,
+                     prompt_buckets=(16, 32), max_new_cap=12,
+                     steps_per_dispatch=2, pipeline_depth=depth),
+        ("mixed", kv_quant),
+    )
     try:
         qa: "queue.Queue" = queue.Queue()
         fa = eng.submit(ids_a, 9, logprobs=True, stream=qa)
@@ -73,7 +99,7 @@ def _mixed_workload(model, params, depth, kv_quant):
             # the pipeline actually ran overlapped at steady state
             assert st["pipeline"]["peak_inflight"] >= 2
     finally:
-        eng.close()
+        _close(eng)
     return {
         "a": (ra["ids"], ra["logprobs"]),
         "b": rb["ids"],
@@ -115,9 +141,12 @@ def test_pipeline_join_bound_depth2():
     step_at_submit + 2 + n_chunks + (depth-1) steps at K=1 (one chunk
     here)."""
     model, params = _model_and_params()
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16,), max_new_cap=16,
-                       steps_per_dispatch=1, pipeline_depth=2)
+    eng = _share(
+        DecodeEngine(model, {"params": params}, slots=2,
+                     prompt_buckets=(16,), max_new_cap=16,
+                     steps_per_dispatch=1, pipeline_depth=2),
+        ("k1",),
+    )
     try:
         qa: "queue.Queue" = queue.Queue()
         eng.submit([3, 14, 15, 9, 2], 16, stream=qa)
@@ -130,7 +159,7 @@ def test_pipeline_join_bound_depth2():
             first_b, step_at_submit
         )
     finally:
-        eng.close()
+        _close(eng)
 
 
 def test_close_with_dispatch_in_flight_fails_pending_exactly_once():
@@ -139,13 +168,18 @@ def test_close_with_dispatch_in_flight_fails_pending_exactly_once():
     error, never InvalidStateError), leaves nothing unread in the
     pipeline, and submit-after-close still raises cleanly."""
     model, params = _model_and_params()
-    eng = DecodeEngine(model, {"params": params}, slots=2,
-                       prompt_buckets=(16,), max_new_cap=16,
-                       steps_per_dispatch=1, pipeline_depth=2)
+    eng = _share(
+        DecodeEngine(model, {"params": params}, slots=2,
+                     prompt_buckets=(16,), max_new_cap=16,
+                     steps_per_dispatch=1, pipeline_depth=2),
+        ("k1",),
+    )
     q: "queue.Queue" = queue.Queue()
     futs = [eng.submit([3, 14, 15, 9, 2], 16, stream=q)]
     q.get(timeout=300)       # decoding: the pipeline holds a dispatch
     futs += [eng.submit([1, 2], 16) for _ in range(3)]  # active + queued
+    if hasattr(eng, "_fns_pool"):
+        eng._fns_pool.update(eng._fns)
     eng.close()
     assert not eng._thread.is_alive()
     assert not eng._inflight  # loop finally dropped the unread outputs

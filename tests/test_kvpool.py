@@ -271,10 +271,9 @@ def test_layout_roundtrip_bit_exact(kv_quant, impl):
             rows = layout_mod._gather_leaf_pallas(
                 np.asarray(pg), table, interpret=True
             )
-            rows = rows.reshape(
-                (slots, lay.max_pages * T) + rows.shape[3:]
+            rebuilt_leaves.append(
+                lay._rows_to_view(spec, jnp.asarray(rows))
             )
-            rebuilt_leaves.append(lay._to_view(spec, jnp.asarray(rows)))
         ki = iter(rebuilt_leaves)
         si = iter(scalars)
         rebuilt = lay.treedef.unflatten([
@@ -322,6 +321,173 @@ def test_layout_page_tokens_must_divide():
     assert lay.max_pages >= -(-24 // 5)
     with pytest.raises(ValueError):
         PagedLayout(cache_abs, 24, 0, num_pages=12)
+
+
+def test_build_slot_row_alloc_end_defers_decode_pages():
+    """Lazy decode allocation: ``alloc_end`` bounds the pages built
+    NOW (the tail stays NULL), and ``extend_slot_row`` grows the
+    committed row all-or-nothing as the cursor approaches."""
+    pool = _pool(num_pages=18, page_tokens=4, l_buf=24)
+    # span [10, 21) = pages 2..5; alloc_end 17 backs only pages 2..4
+    row, mask, _ = pool.build_slot_row(10, 21, alloc_end=17)
+    assert all(p >= RESERVED_PAGES for p in row[2:5])
+    assert row[5] == NULL_PAGE and not mask[5]
+    pool.commit_slot_row(0, row)
+    used0 = pool.alloc.used_pages
+    row2 = pool.extend_slot_row(0, 5, 6)
+    assert row2[5] >= RESERVED_PAGES
+    assert pool.alloc.used_pages == used0 + 1
+    assert (pool.tables[0] == row2).all()
+    pool.check_invariants()
+    # exhaustion is all-or-nothing: a failed extend changes nothing
+    pool.alloc.alloc(pool.alloc.free_pages)
+    with pytest.raises(NoFreePages):
+        pool.extend_slot_row(0, 0, 1)  # pos 0 is NULL (pad prefix)
+    assert pool.tables[0][0] == NULL_PAGE
+    # private_pages_needed honors the same bound
+    assert pool.private_pages_needed(10, 21, alloc_end=17) == 3
+    assert pool.private_pages_needed(10, 21) == 4
+
+
+@pytest.mark.parametrize("chunk", [False, True])
+def test_paged_kernel_bit_exact_vs_dense(chunk):
+    """The paged Pallas kernels (interpret mode) against the dense
+    kernels on the same cache bytes scattered into pages through a
+    permuted table: BIT-exact, with NULL pages outside the windows
+    skipped and unmapped pages poisoned with NaN scale bytes (a
+    skipped page's garbage must never reach the accumulator — the
+    interpret-mode unit that catches in-kernel DMA/masking bugs the
+    engine matrix would only surface as diverged tokens)."""
+    from mlcomp_tpu.ops.pallas.decode_attention import (
+        decode_attention,
+        decode_attention_chunk,
+        paged_decode_attention,
+        paged_decode_attention_chunk,
+        quantize_kv,
+    )
+
+    rng = np.random.RandomState(0)
+    B, H, HKV, DH, L, T = 2, 4, 2, 128, 128, 32
+    MP = L // T
+    k8, ks = quantize_kv(jnp.asarray(
+        rng.randn(B, HKV, L, DH).astype(np.float32)
+    ))
+    v8, vs = quantize_kv(jnp.asarray(
+        rng.randn(B, HKV, L, DH).astype(np.float32)
+    ))
+    ks4 = ks[:, :, None, :].astype(jnp.bfloat16)
+    vs4 = vs[:, :, None, :].astype(jnp.bfloat16)
+    start = jnp.asarray(np.array([5, 40], np.int32))
+    # pages: permuted physical placement; UNMAPPED pages poisoned
+    P = RESERVED_PAGES + B * MP
+    perm = rng.permutation(B * MP)
+    table = np.zeros((B, MP), np.int32)
+    kqp = np.zeros((P, HKV, T, DH), np.int8)
+    vqp = np.zeros((P, HKV, T, DH), np.int8)
+    ksp = np.full((P, HKV, 1, T), np.nan, np.float32)
+    vsp = np.full((P, HKV, 1, T), np.nan, np.float32)
+    k8n, v8n = np.asarray(k8), np.asarray(v8)
+    ks4n = np.asarray(ks4.astype(jnp.float32))
+    vs4n = np.asarray(vs4.astype(jnp.float32))
+    for b in range(B):
+        for p in range(MP):
+            pid = RESERVED_PAGES + int(perm[b * MP + p])
+            table[b, p] = pid
+            kqp[pid] = k8n[b, :, p * T:(p + 1) * T, :]
+            vqp[pid] = v8n[b, :, p * T:(p + 1) * T, :]
+            ksp[pid] = ks4n[b, :, :, p * T:(p + 1) * T]
+            vsp[pid] = vs4n[b, :, :, p * T:(p + 1) * T]
+    pages = (jnp.asarray(kqp), jnp.asarray(ksp).astype(jnp.bfloat16),
+             jnp.asarray(vqp), jnp.asarray(vsp).astype(jnp.bfloat16))
+    if chunk:
+        S = 3
+        q = jnp.asarray(rng.randn(B, S, H, DH).astype(np.float32))
+        stop0 = jnp.asarray(np.array([100, 41], np.int32))
+        dense = decode_attention_chunk(
+            q, k8, ks4, v8, vs4, kv_start=start, kv_stop0=stop0
+        )
+        paged = paged_decode_attention_chunk(
+            q, *pages, jnp.asarray(table), kv_start=start,
+            kv_stop0=stop0,
+        )
+    else:
+        q = jnp.asarray(rng.randn(B, H, DH).astype(np.float32))
+        stop = jnp.asarray(np.array([100, 41], np.int32))
+        dense = decode_attention(
+            q, k8, ks4, v8, vs4, kv_start=start, kv_stop=stop
+        )
+        # NULL out every page fully outside the window: the kernel
+        # must skip them (no DMA) and still match
+        tbl2 = table.copy()
+        for b, (lo, hi) in enumerate(zip((5, 40), (100, 41))):
+            for p in range(MP):
+                if (p + 1) * T <= lo or p * T >= hi:
+                    tbl2[b, p] = NULL_PAGE
+        paged_null = paged_decode_attention(
+            q, *pages, jnp.asarray(tbl2), kv_start=start, kv_stop=stop
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dense), np.asarray(paged_null)
+        )
+        paged = paged_decode_attention(
+            q, *pages, jnp.asarray(table), kv_start=start, kv_stop=stop
+        )
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+
+def test_paged_wide_chunk_fallback_matches_dense():
+    """Chunk widths past CHUNK_MAX_SQ (spec_k >= 32) take the XLA
+    dequant fallback on BOTH paths — dense reads its buffer, fused
+    reads a table gather of identical bytes — and must stay bit-equal
+    (the fallback is a hand-mirrored copy of chunk_attend's dense
+    branch; this test is what keeps the two from drifting)."""
+    from mlcomp_tpu.kvpool import PagedKV, paged_kv
+    from mlcomp_tpu.ops.pallas.decode_attention import CHUNK_MAX_SQ
+
+    model, init_cache = _cache_family(True)
+    slots, l_buf, T = 2, 48, 4
+    s = CHUNK_MAX_SQ + 1
+    rng = np.random.RandomState(5)
+    from mlcomp_tpu.train.state import init_model
+
+    prompt = jnp.asarray(rng.randint(1, 64, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(2))
+    cache = init_cache(model, slots, l_buf)
+    cache_abs = jax.eval_shape(lambda: init_cache(model, 1, l_buf))
+    lay = PagedLayout(cache_abs, l_buf, T)
+    lay.num_pages = RESERVED_PAGES + slots * lay.max_pages
+    table = np.full((slots, lay.max_pages), GRAVE_PAGE, np.int32)
+    nxt = RESERVED_PAGES
+    for s_ in range(slots):
+        for p in range(lay.max_pages):
+            table[s_, p] = nxt
+            nxt += 1
+    table = jnp.asarray(table)
+    pages = lay.scatter(lay.fresh_pages(), table, cache)
+
+    tok = jnp.asarray(rng.randint(1, 64, (slots, s)))
+    cur = jnp.asarray(np.array([2, 5], np.int32))
+    pos = cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    kv_mask = jnp.ones((slots, l_buf), bool)
+
+    def dense_step(cache_in):
+        return model.apply(
+            {"params": params, "cache": cache_in}, tok, decode=True,
+            positions=pos, kv_mask=kv_mask, cache_cursor=cur,
+            mutable=["cache"],
+        )[0]
+
+    def fused_step(pages_in):
+        ctx = PagedKV(lay, pages_in, table, impl="auto")
+        with paged_kv(ctx):
+            logits, _ = model.apply(
+                {"params": params}, tok, decode=True, positions=pos,
+                kv_mask=kv_mask, cache_cursor=cur, mutable=["cache"],
+            )
+        return logits
+    d = jax.jit(dense_step)(cache)
+    f = jax.jit(fused_step)(pages)
+    np.testing.assert_array_equal(np.asarray(d), np.asarray(f))
 
 
 def test_insert_rows_routes_shared_to_grave():

@@ -104,7 +104,11 @@ def test_pool_provisions_gang_restarts_dead_daemon(tmp_path, tmp_db):
         env=env,
     )
     sup = Supervisor(store, worker_timeout_s=12.0)
-    dag_id, tid = _submit_gang_sleep_dag(store, tmp_path / "src", sleep_s=25)
+    # long enough that the SIGKILL below lands mid-task even on a
+    # slow box (the IN_PROGRESS gate fires within one babysit tick,
+    # ~0.4 s), short enough that the retry's full re-run does not
+    # dominate the tier-1 budget
+    dag_id, tid = _submit_gang_sleep_dag(store, tmp_path / "src", sleep_s=12)
 
     killed = {}
 

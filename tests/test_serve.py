@@ -26,14 +26,36 @@ def _tiny_model():
     })
 
 
+# share the continuous engine's compiled programs across the DEFAULT-
+# config services in this module (the _fns idiom from
+# tests/test_engine_fused_admit.py): five tests build the identical
+# continuous service, and each was paying the full prefill + insert +
+# dispatch compile bill — the single biggest line in the tier-1 time
+# budget.  Only the exact default config shares; any engine-visible
+# kwarg opts out.
+_CONT_FNS: dict = {}
+
+
 def _service(**kw):
     model = _tiny_model()
     prompt = jnp.asarray(np.random.RandomState(0).randint(1, 64, (1, 8)))
     params, mstate = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    share = kw == {"batcher": "continuous"}
     kw.setdefault("batch_sizes", (1, 2, 4))
     kw.setdefault("prompt_buckets", (8, 16))
     kw.setdefault("max_new_buckets", (4, 8))
-    return model, GenerationService(model, {"params": params, **mstate}, **kw)
+    svc = GenerationService(model, {"params": params, **mstate}, **kw)
+    if share and svc.engine is not None:
+        eng = svc.engine
+        eng._fns.update(_CONT_FNS)
+        orig_close = svc.close
+
+        def close(*a, **k):
+            _CONT_FNS.update(eng._fns)
+            return orig_close(*a, **k)
+
+        svc.close = close
+    return model, svc
 
 
 def test_bucket_helper():

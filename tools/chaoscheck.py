@@ -30,7 +30,16 @@ watchdog armed):
 - **page-pool exhaustion** (paged KV): a concurrent flood past the
   free-page budget produces BOUNDED 429s with reason
   ``no_free_pages`` (never a hang, never a 5xx), survivors stay
-  bit-identical, and at quiesce the pool holds zero leaked pages.
+  bit-identical, and at quiesce the pool holds zero leaked pages;
+- **lazy-allocation exhaustion MID-DECODE** (scenario 7, fused paged
+  attention): admission overcommits the pool against decode budgets
+  (pages allocate lazily as cursors cross page boundaries), so a
+  tightly-sized pool can run dry at a crossing with rows mid-stream.
+  The starved row must fail TYPED (``NoFreePages``, status
+  ``no_free_pages``) at the dispatch boundary — never a hang, never a
+  fleet error — its freed pages must unblock the neighbour starved in
+  the same tick, the surviving stream's tokens must be bit-identical
+  to a solo run, and at quiesce the pool holds zero leaked pages.
 
 The daemon runs the PAGED device KV layout (``kv_layout="paged"``,
 mlcomp_tpu/kvpool), so every scenario above also exercises the page
@@ -377,6 +386,7 @@ def run() -> dict:
             "cache_degraded": h["engine"]["cache_degraded"],
         }
         out["page_pool_exhaustion"] = _scenario_page_exhaustion()
+        out["lazy_page_exhaustion"] = _scenario_lazy_page_exhaustion()
         return out
     finally:
         faults.disarm_all()
@@ -458,6 +468,114 @@ def _scenario_page_exhaustion() -> dict:
         }
     finally:
         d.close()
+
+
+def _scenario_lazy_page_exhaustion() -> dict:
+    """Scenario 7 — page exhaustion hit MID-DECODE by lazy allocation
+    (fused paged attention).  A parked-loop engine makes it
+    deterministic: the pool is sized so two streams' INITIAL needs fit
+    exactly (admission overcommits against their decode budgets), both
+    decode until their cursors approach the lazily-deferred last page,
+    and the extend tick finds the pool dry — slot 0 must fail typed
+    and free its pages, slot 1 must pick those pages up IN THE SAME
+    TICK and finish with tokens bit-identical to its solo run.  Zero
+    leaks at quiesce."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp  # noqa: F401
+    import numpy as np
+    from concurrent.futures import Future
+
+    from mlcomp_tpu.engine import DecodeEngine, _POISON
+    from mlcomp_tpu.kvpool import NoFreePages
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.train.state import init_model
+
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+        "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32",
+    })
+    prompt = jnp.asarray(np.random.RandomState(0).randint(1, 64, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    # geometry: bucket 16 + max_new 8 + scratch = 25-slot rows over
+    # 8-token pages -> 4 pages worst, 3 at admission (prefill + one
+    # K=4 dispatch of lookahead).  6 allocatable pages fit BOTH
+    # initial needs and NEITHER worst case — the overcommit under test
+    eng = DecodeEngine(
+        model, {"params": params}, slots=2, prompt_buckets=(16,),
+        max_new_cap=8, prefill_chunk=8, steps_per_dispatch=4,
+        kv_layout="paged", max_slots=2, kv_pages=2 + 6,
+    )
+    eng._stop.set()
+    eng._queue.put(_POISON)
+    eng._thread.join(timeout=60)
+
+    def req(ids, n_new=8):
+        return {
+            "ids": list(ids), "n_new": n_new, "future": Future(),
+            "temperature": 0.0, "top_k": eng.vocab, "top_p": 1.0,
+            "eos_id": -1, "logprobs": False, "repetition_penalty": 1.0,
+            "stream": None, "t_submit": time.perf_counter(),
+            "t_deadline": None, "rid": 0, "warmup": False,
+        }
+
+    ids_a = [9, 10, 11, 12, 13, 14, 15, 16, 17, 3]
+    ids_b = [21, 22, 23, 24, 25, 26, 27, 28, 29, 5]
+
+    def admit(r):
+        eng._start_admission(r)
+        while eng._adm is not None:
+            eng._run_admission_chunk()
+
+    def drain_to_done(futs, max_dispatches=8):
+        for _ in range(max_dispatches):
+            if all(f.done() for f in futs):
+                break
+            eng._run_dispatch()
+
+    try:
+        # solo baseline for the survivor's prompt (same engine — same
+        # compiled programs, so "bit-identical" is meaningful)
+        rb0 = req(ids_b)
+        admit(rb0)
+        drain_to_done([rb0["future"]])
+        solo = rb0["future"].result(timeout=60)["ids"]
+        assert len(solo) == 8, solo
+        eng._pool.reclaim_all()  # drop registry pins: clean slate
+        st = eng._pool.stats()
+        assert st["pages_free"] == st["pages_total"], st
+
+        ra, rb = req(ids_a), req(ids_b)
+        admit(ra)
+        admit(rb)
+        assert eng._pool.alloc.free_pages == 0  # overcommitted exactly
+        drain_to_done([ra["future"], rb["future"]])
+        # slot 0 starved at the page crossing: typed, never a hang
+        try:
+            ra["future"].result(timeout=60)
+            raise AssertionError("overcommitted row did not fail typed")
+        except NoFreePages as e:
+            assert getattr(e, "status", None) == "no_free_pages", e
+        # its freed pages unblocked the neighbour in the same tick
+        out_b = rb["future"].result(timeout=60)["ids"]
+        assert out_b == solo, (out_b, solo)
+        st = eng.stats()
+        assert st["kv_decode_page_failures"] == 1, st
+        assert st["kv_pages_lazy_allocated"] >= 1, st
+        pool = eng._pool
+        pool.reclaim_all()
+        pst = pool.stats()
+        assert pst["pages_free"] == pst["pages_total"], pst
+        assert pst["outstanding_page_leases"] == 0, pst
+        pool.check_invariants()
+        return {
+            "starved_typed": True, "survivor_exact": True,
+            "pages_leaked": 0,
+            "lazy_pages": int(st["kv_pages_lazy_allocated"]),
+        }
+    finally:
+        eng.close()
 
 
 def main(argv=None) -> int:

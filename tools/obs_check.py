@@ -83,6 +83,9 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_engine_max_slots",
     "mlcomp_engine_kv_registry_hits_total",
     "mlcomp_engine_kv_registry_hit_tokens_total",
+    "mlcomp_engine_kv_bytes_moved_per_dispatch",
+    "mlcomp_engine_kv_pages_lazy_allocated_total",
+    "mlcomp_engine_kv_decode_page_failures_total",
     "mlcomp_engine_deadline_exceeded_total",
     "mlcomp_engine_cancelled_total",
     "mlcomp_engine_watchdog_stalls_total",
@@ -191,7 +194,7 @@ def _counters_monotonic(before, after, types):
             )
 
 
-def run(n_requests: int = 4) -> dict:
+def run(n_requests: int = 3) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -224,9 +227,9 @@ def run(n_requests: int = 4) -> dict:
     port = httpd.server_address[1]
     base = f"http://127.0.0.1:{port}"
 
-    def generate(ids):
+    def generate(ids, max_new=4):
         body = json.dumps(
-            {"prompt": ids, "max_new_tokens": 4}
+            {"prompt": ids, "max_new_tokens": max_new}
         ).encode()
         req = urllib.request.Request(
             f"{base}/generate", data=body,
@@ -306,12 +309,19 @@ def run(n_requests: int = 4) -> dict:
             # misses the placement-exact device registry and exercises
             # the HOST prefix-cache tier (token-indexed, re-placed)
             generate(shared + [100 + i, 7])
+        # FULL-budget decodes: max_new 8 pushes the write span past
+        # the insert's one-dispatch lookahead, so the fused paged
+        # engine allocates its last decode page LAZILY mid-stream —
+        # the counter asserted below
+        for i in range(2):
+            out = generate(shared + [200 + i], max_new=8)
+            assert len(out["ids"]) == 8, out
         text2 = get("/metrics").decode()
         s2, t2 = parse_exposition(text2)
         check_histograms(s2, t2)
         _counters_monotonic(s1, s2, t1)
         req1 = s2["mlcomp_engine_requests_total"][""]
-        assert req1 == req0 + 2 * n_requests, (req0, req1)
+        assert req1 == req0 + 2 * n_requests + 2, (req0, req1)
         assert s2["mlcomp_prefix_cache_hits_total"][""] > 0
         # paged-KV pool gauges carry live occupancy, and the device
         # registry tier absorbed the same-placement repeats
@@ -320,6 +330,12 @@ def run(n_requests: int = 4) -> dict:
         assert kv_total > 0 and 0 <= kv_free <= kv_total
         assert s2["mlcomp_engine_kv_registry_hits_total"][""] > 0
         assert s2["mlcomp_engine_live_slots"][""] >= 1
+        # fused paged attention (the daemon's default data path):
+        # the bytes-moved gauge is live, the full-budget decodes above
+        # allocated decode pages lazily, and nothing starved
+        assert s2["mlcomp_engine_kv_bytes_moved_per_dispatch"][""] >= 0
+        assert s2["mlcomp_engine_kv_pages_lazy_allocated_total"][""] > 0
+        assert s2["mlcomp_engine_kv_decode_page_failures_total"][""] == 0
 
         trace = json.loads(get("/trace?last_ms=600000"))
         evs = trace["traceEvents"]
