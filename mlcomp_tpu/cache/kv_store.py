@@ -226,9 +226,11 @@ class PrefixKVCache:
         for key in ("used_hits", "used_hit_tokens", "insert_errors",
                     "insert_dropped"):
             self.index.counters[key] = 0
-        self._keys_axes: Optional[List[Tuple[str, int]]] = None
+        self._keys_axes: Optional[List[Tuple[str, int]]] = (  # guarded_by: loop [writes]
+            None
+        )
         self._q: "queue.Queue" = queue.Queue(maxsize=8)
-        self._warned = False
+        self._warned = False  # guarded_by: worker [writes]
         self._closed = False
         self._worker = threading.Thread(
             target=self._drain, daemon=True, name="prefix-kv-capture"
@@ -237,7 +239,7 @@ class PrefixKVCache:
 
     # engine admission path -------------------------------------------
 
-    def bind_layout(self, cache) -> None:
+    def bind_layout(self, cache) -> None:  # graftcheck: runs-on(loop)
         """Record the engine cache's leaf order/axes once (abstract
         pytree is fine); lookups before the first capture share it."""
         if self._keys_axes is None:
@@ -304,7 +306,7 @@ class PrefixKVCache:
             self._q.task_done()
         self._q.put(None)  # wakes the worker; it exits on the sentinel
 
-    def _drain(self) -> None:
+    def _drain(self) -> None:  # graftcheck: runs-on(worker)
         import warnings
 
         while True:

@@ -105,23 +105,24 @@ class PrefixIndex:
 
     def __init__(self, max_bytes: int):
         self.max_bytes = int(max_bytes)
-        self._root = _Node((), None, None)
+        self._root = _Node((), None, None)  # guarded_by: _lock
         self._lock = threading.RLock()
-        self._bytes = 0
+        self._bytes = 0  # guarded_by: _lock
         # node/pinned counts maintained INCREMENTALLY (every mutation
         # funnels through insert/evict/lookup/release under the lock):
         # stats() backs /healthz and the report proxy, and an O(N) walk
         # per monitoring poll would hold the lock the engine loop
         # thread's admissions need
-        self._nodes = 0
-        self._pinned = 0
+        self._nodes = 0  # guarded_by: _lock
+        self._pinned = 0  # guarded_by: _lock
         # unreleased Lease count — the caller-facing leak unit behind
         # the chaoscheck invariant that no engine fault path leaks a
         # pin (distinct leases can share pinned nodes, so pinned_nodes
         # alone under-counts outstanding leases)
-        self._leases = 0
-        self._clock = 0  # monotonic LRU tick (time.monotonic ties on fast ops)
-        self.counters = {
+        self._leases = 0  # guarded_by: _lock
+        # monotonic LRU tick (time.monotonic ties on fast ops)
+        self._clock = 0  # guarded_by: _lock
+        self.counters = {  # guarded_by: _lock
             "lookups": 0, "hits": 0, "misses": 0, "matched_tokens": 0,
             "inserted_tokens": 0, "evictions": 0, "evicted_tokens": 0,
         }
@@ -281,7 +282,7 @@ class PrefixIndex:
 
     # ------------------------------------------------------------ private
 
-    def _evict_to_budget(self) -> int:
+    def _evict_to_budget(self) -> int:  # graftcheck: holds(_lock)
         """ONE tree walk collects the evictable leaves into a heap;
         parents join as their last child goes — O(N + M log N) per
         burst, not a fresh full scan per victim (the lock this runs

@@ -603,9 +603,11 @@ class DecodeEngine:
             # ids, no bucket pads): the n-gram draft's source
             self.t_ids = self.prompt_buckets[-1] + self.max_new_cap
         self._seed = int(seed)
-        self._dstate = self._fresh_dstate()
-        self._host: List[Optional[_Slot]] = [None] * self.slots
-        self._adm: Optional[_Admission] = None
+        self._dstate = self._fresh_dstate()  # guarded_by: loop
+        self._host: List[Optional[_Slot]] = (  # guarded_by: loop [writes]
+            [None] * self.slots
+        )
+        self._adm: Optional[_Admission] = None  # guarded_by: loop [writes]
         self._broken: Optional[Exception] = None
         self._abandoned = False
         self._queue: "queue.Queue" = queue.Queue()
@@ -613,12 +615,12 @@ class DecodeEngine:
         # thread-safe handoff); the loop pumps it into _pending, where
         # deadline/cancel sweeps can retire QUEUED requests at a
         # dispatch boundary instead of only when a slot frees up
-        self._pending: Deque[Dict[str, Any]] = deque()
+        self._pending: Deque[Dict[str, Any]] = deque()  # guarded_by: loop [writes]
         # rids cancelled via cancel() but not yet retired by the loop's
         # boundary sweep (set add/discard are atomic under the GIL; the
         # sweep runs on the loop thread)
         self._cancelled: set = set()
-        self._stats = {
+        self._stats = {  # guarded_by: loop [writes]
             "requests": 0, "steps": 0, "prefills": 0, "dispatches": 0,
             "prefill_chunks": 0, "emitted_tokens": 0,
             # fused-admission accounting: fused_chunks counts the
@@ -653,13 +655,13 @@ class DecodeEngine:
         # device buffer, host issue time, dispatch seq — the flight
         # recorder's async-span id).  Owned by the loop thread;
         # close()'s normal path touches it only after the join.
-        self._inflight: Deque[Tuple[Any, float, int]] = deque()
+        self._inflight: Deque[Tuple[Any, float, int]] = deque()  # guarded_by: loop [writes]
         # overlap accounting: hidden_ms is host work done between a
         # dispatch's issue and the host blocking on its outputs (the
         # time the pipeline hid behind device compute), wait_ms the
         # blocked remainder; inflight_sum/issued is the mean in-flight
         # depth at issue (occupancy)
-        self._pstats = {
+        self._pstats = {  # guarded_by: loop [writes]
             "issued": 0, "hidden_ms": 0.0, "wait_ms": 0.0,
             "inflight_sum": 0, "peak_inflight": 0,
         }
@@ -670,10 +672,10 @@ class DecodeEngine:
         # keep long runs honest — len(deque) saturates at maxlen and
         # silently misrepresents how many requests the percentiles
         # summarize
-        self._lat_ttft: Deque[float] = deque(maxlen=2048)
-        self._lat_tok: Deque[float] = deque(maxlen=2048)
-        self._lat_ttft_n = 0
-        self._lat_tok_n = 0
+        self._lat_ttft: Deque[float] = deque(maxlen=2048)  # guarded_by: loop [writes]
+        self._lat_tok: Deque[float] = deque(maxlen=2048)  # guarded_by: loop [writes]
+        self._lat_ttft_n = 0  # guarded_by: loop [writes]
+        self._lat_tok_n = 0  # guarded_by: loop [writes]
         # flight recorder: an always-on bounded ring of dispatch /
         # admission / prefix-cache / request-lifecycle events, exported
         # on demand (serve's GET /trace).  0/None disables (the bench
@@ -722,7 +724,7 @@ class DecodeEngine:
         # request at a time — HTTP threads arm under _prof_lock, the
         # loop thread starts/stops/attributes it at dispatch boundaries
         self._prof_lock = threading.Lock()
-        self._profile: Optional[Dict[str, Any]] = None
+        self._profile: Optional[Dict[str, Any]] = None  # guarded_by: _prof_lock [writes]
         self._last_attr: Optional[Dict[str, Any]] = None
         # HBM-roofline accounting for the device-time attribution: one
         # decode forward streams the full weight tree plus its KV
@@ -938,6 +940,7 @@ class DecodeEngine:
         if _count:
             # warmup's dummy submissions pass _count=False so the
             # service-visible request count means real requests only
+            # graftcheck: ignore[unguarded-write] -- GIL-atomic int add; the sole off-loop writer, and the only writer of this key
             self._stats["requests"] += 1
         return fut
 
@@ -1412,7 +1415,7 @@ class DecodeEngine:
         self._drain_pending(err)
         self._drain_queue(err)
 
-    def _fail_admission(self, err: Exception) -> None:
+    def _fail_admission(self, err: Exception) -> None:  # graftcheck: runs-on(loop)
         """Terminate the in-flight admission (if any): stream closed,
         future failed — the one teardown sequence every failure path
         shares."""
@@ -1443,7 +1446,7 @@ class DecodeEngine:
                 continue
             self._fail_queued(req, err)
 
-    def _drain_pending(self, err: Exception) -> None:
+    def _drain_pending(self, err: Exception) -> None:  # graftcheck: runs-on(loop)
         while self._pending:
             self._fail_queued(self._pending.popleft(), err)
 
@@ -1796,7 +1799,7 @@ class DecodeEngine:
             )
         return self._fns["set_table"]
 
-    def _lazy_extend_tick(self) -> None:
+    def _lazy_extend_tick(self) -> None:  # graftcheck: runs-on(loop)
         """Page-granular LAZY decode allocation (paged layout): before
         each dispatch issues, make sure every live slot's mapping
         covers the cache slots the in-flight window can write —
@@ -1863,7 +1866,7 @@ class DecodeEngine:
                 jnp.asarray(pool.tables[: len(self._host)]),
             )
 
-    def _release_slot_pages(self, slot: int) -> None:
+    def _release_slot_pages(self, slot: int) -> None:  # graftcheck: runs-on(loop)
         """Live-path slot teardown (paged): grave the device table row,
         then release the host-side page references.  Called wherever a
         slot frees on the LIVE engine (natural finish, deadline/cancel
@@ -2006,7 +2009,7 @@ class DecodeEngine:
             self._fns[key] = self._jax.jit(resize)
         return self._fns[key]
 
-    def _scale_slots(self, ns2: int) -> None:
+    def _scale_slots(self, ns2: int) -> None:  # graftcheck: runs-on(loop)
         """Resize the live slot count (caller has drained the
         pipeline: in-flight packed outputs are shaped at the old
         width).  The dispatch/insert/deactivate programs re-trace at
@@ -2071,7 +2074,7 @@ class DecodeEngine:
             return free
         return free + self._pool.reclaimable_pages()
 
-    def _pop_admittable(self) -> Optional[Dict[str, Any]]:
+    def _pop_admittable(self) -> Optional[Dict[str, Any]]:  # graftcheck: runs-on(loop)
         """The FIFO head of the pending deque, if it can be admitted at
         this boundary.  Dense: always.  Paged: the head must fit the
         free-page budget at its INITIAL need — prefill pages plus one
@@ -2468,7 +2471,7 @@ class DecodeEngine:
 
     # ------------------------------------------------------- admission
 
-    def _start_admission(self, req) -> None:
+    def _start_admission(self, req) -> None:  # graftcheck: runs-on(loop)
         """Begin a chunked prefill for ``req`` (a free slot exists —
         checked by the caller; slots only free up while it runs)."""
         from mlcomp_tpu.serve import left_pad_row
@@ -2645,7 +2648,7 @@ class DecodeEngine:
         adm.capture_lo = adm.next_chunk * c
         self._adm = adm
 
-    def _run_admission_chunk(self) -> None:
+    def _run_admission_chunk(self) -> None:  # graftcheck: runs-on(loop)
         """Run ONE STAGED prefill chunk — its own dispatch at a drained
         boundary, the pre-fused behavior (``fused_admission=False``,
         admissions with no decode fleet to ride, and the bench/tools
@@ -2708,7 +2711,7 @@ class DecodeEngine:
         return (jnp.asarray(adm.row[:, lo:lo + c]),
                 jnp.asarray(adm.positions[:, lo:lo + c]))
 
-    def _drain_inflight(self) -> None:
+    def _drain_inflight(self) -> None:  # graftcheck: runs-on(loop)
         """Resolve every in-flight dispatch (the recorded join_drain).
         Runs at LOOP level only: a dispatch failure surfacing here is
         an ENGINE-level error — the fleet's tokens are on the line, so
@@ -2737,7 +2740,7 @@ class DecodeEngine:
             return f"{base}+prefill_c{fused_chunk}"
         return base
 
-    def _profile_tick(self) -> None:
+    def _profile_tick(self) -> None:  # graftcheck: runs-on(loop)
         """Loop-thread: advance the armed/active on-demand capture at
         this dispatch boundary.  Start only once there is decode work
         to record, at a clean boundary (in-flight dispatches from
@@ -2826,7 +2829,7 @@ class DecodeEngine:
             self._busy_since = None
         self._finish_profile()
 
-    def _finish_profile(self, error: Optional[Exception] = None) -> None:
+    def _finish_profile(self, error: Optional[Exception] = None) -> None:  # graftcheck: runs-on(loop)
         """Complete (or abort) the in-flight capture: close the trace
         window if still open, parse + attribute on success, clean the
         capture dir, resolve the future.  Never raises — it runs on
@@ -3081,7 +3084,7 @@ class DecodeEngine:
             return fw * (live + 2 * dense)
         return (fw + 2) * dense + 2 * live
 
-    def _complete_admission(self) -> None:
+    def _complete_admission(self) -> None:  # graftcheck: runs-on(loop)
         """Final admission boundary — the ONE synchronous stall the
         fused path keeps: queue the prefix-cache capture, insert the
         prefilled row at a free slot.  The caller has already drained
@@ -3110,7 +3113,7 @@ class DecodeEngine:
         self._stats["prefills"] += 1
         self._adm = None
 
-    def _insert_admission(self, jnp, adm, req, s_bucket) -> None:
+    def _insert_admission(self, jnp, adm, req, s_bucket) -> None:  # graftcheck: runs-on(loop)
         if (self.prefix_cache is not None and not req.get("warmup")
                 and not adm.skip_capture):
             # queue the finished prefill's real-token K/V rows for the
@@ -3242,7 +3245,7 @@ class DecodeEngine:
                               // T) * T
         self._host[slot] = sl
 
-    def _finish(self, slot_idx: int, error: Optional[Exception] = None):
+    def _finish(self, slot_idx: int, error: Optional[Exception] = None):  # graftcheck: runs-on(loop)
         sl = self._host[slot_idx]
         self._host[slot_idx] = None
         if sl is None:
@@ -3296,7 +3299,7 @@ class DecodeEngine:
         # stall the runtime later recovered from — its verdict stands
         _set_result(req["future"], result)
 
-    def _issue_dispatch(self, fused=None) -> None:
+    def _issue_dispatch(self, fused=None) -> None:  # graftcheck: runs-on(loop)
         """Issue ONE dispatch and return WITHOUT blocking on its
         outputs: one device call (state device-carried + donated),
         nothing per-slot uploaded.  The donated carry chains device-
@@ -3381,7 +3384,7 @@ class DecodeEngine:
                 "dispatch", seq, cat="disp", inflight=len(self._inflight),
             )
 
-    def _process_oldest(self) -> None:
+    def _process_oldest(self) -> None:  # graftcheck: runs-on(loop)
         """Block on the OLDEST in-flight dispatch's packed outputs and
         run the host half: stream/bookkeep its tokens, retire finished
         rows.  FIFO processing keeps step numbering, stream order, and
@@ -3479,7 +3482,7 @@ class DecodeEngine:
                 stacklevel=2,
             )
 
-    def _run_dispatch(self) -> None:
+    def _run_dispatch(self) -> None:  # graftcheck: runs-on(loop)
         # the synchronous compose (= pipeline depth 1): issue, then
         # resolve everything in flight.  Kept as the one-call entry
         # point for the bench/tools that drive the engine by hand.
@@ -3487,7 +3490,7 @@ class DecodeEngine:
         while self._inflight:
             self._process_oldest()
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # graftcheck: runs-on(loop)
         try:
             self._loop_body()
         finally:
@@ -3514,7 +3517,7 @@ class DecodeEngine:
 
     # ------------------------------------------------ boundary maintenance
 
-    def _pump_queue(self, block_s: float = 0.0) -> None:
+    def _pump_queue(self, block_s: float = 0.0) -> None:  # graftcheck: runs-on(loop)
         """Move everything parked in the thread-safe submit queue into
         the loop-owned ``_pending`` deque, where the deadline/cancel
         sweep can retire QUEUED requests at a dispatch boundary instead
@@ -3553,7 +3556,7 @@ class DecodeEngine:
                 )
         return None
 
-    def _count_retire(self, err: Exception, req: Dict[str, Any]) -> None:
+    def _count_retire(self, err: Exception, req: Dict[str, Any]) -> None:  # graftcheck: runs-on(loop)
         rid = req.get("rid", 0)
         if isinstance(err, RequestCancelled):
             self._stats["cancelled"] += 1
@@ -3563,7 +3566,7 @@ class DecodeEngine:
             self.recorder.instant("deadline", track="engine.loop", rid=rid)
         self._cancelled.discard(rid)
 
-    def _boundary_maintenance(self, block_s: float = 0.0) -> None:
+    def _boundary_maintenance(self, block_s: float = 0.0) -> None:  # graftcheck: runs-on(loop)
         """Per-boundary housekeeping (loop thread): pump the submit
         queue, then retire queued and active requests whose deadline
         passed or whose rid was cancelled.  Queued requests fail in
@@ -3608,7 +3611,7 @@ class DecodeEngine:
 
     # -------------------------------------------------------- drive loop
 
-    def _loop_body(self) -> None:
+    def _loop_body(self) -> None:  # graftcheck: runs-on(loop)
         while not (self._stop.is_set() or self._exit_loop.is_set()):
             if self._broken is not None:
                 # engine-level failure (donated buffers may be gone):
@@ -3781,6 +3784,7 @@ class DecodeEngine:
             f"dispatch exceeded dispatch_stall_timeout="
             f"{self.dispatch_stall_timeout}s (stuck {stuck_s:.1f}s)"
         )
+        # graftcheck: ignore[unguarded-write] -- watchdog thread; GIL-atomic add to a key only this thread writes
         self._stats["watchdog_stalls"] += 1
         self._unhealthy_reason = str(err)
         self._broken = err      # submits fail fast from here on
@@ -3838,7 +3842,7 @@ class DecodeEngine:
                 req["stream"].put(None)
             _fail_future(req["future"], err)
 
-    def _maybe_restart(self) -> bool:
+    def _maybe_restart(self) -> bool:  # graftcheck: runs-on(loop)
         """One bounded restart of a dead drive loop: rebuild the device
         carry from scratch (the old pytree may have died mid-donation)
         and start a fresh thread.  Refuses when closing/abandoned, or

@@ -65,14 +65,14 @@ class PageAllocator:
             )
         # LIFO free list: recently-freed pages are re-used first, which
         # keeps the hot working set small whatever the churn pattern
-        self._free: List[int] = list(
+        self._free: List[int] = list(  # guarded_by: loop [writes]
             range(self.num_pages - 1, RESERVED_PAGES - 1, -1)
         )
-        self._refs: Dict[int, int] = {}
-        self.counters = {
+        self._refs: Dict[int, int] = {}  # guarded_by: loop [writes]
+        self.counters = {  # guarded_by: loop [writes]
             "allocs": 0, "frees": 0, "cow_forks": 0, "failed_allocs": 0,
         }
-        self._peak_used = 0
+        self._peak_used = 0  # guarded_by: loop [writes]
 
     # ------------------------------------------------------------- queries
 
@@ -94,7 +94,7 @@ class PageAllocator:
 
     # ----------------------------------------------------------- lifecycle
 
-    def alloc(self, n: int, cow_fork: int = 0) -> List[int]:
+    def alloc(self, n: int, cow_fork: int = 0) -> List[int]:  # graftcheck: runs-on(loop)
         """Take ``n`` pages off the free list at ref 1.  All-or-nothing:
         a partial grab under pressure would leak unless every caller
         wrote perfect unwind code.  ``cow_fork`` counts how many of the
@@ -118,7 +118,7 @@ class PageAllocator:
         self._peak_used = max(self._peak_used, self.used_pages)
         return out
 
-    def retain(self, page: int) -> None:
+    def retain(self, page: int) -> None:  # graftcheck: runs-on(loop)
         """Add a reference to a live page (prefix sharing: mapping an
         existing page into another slot table or the registry)."""
         page = int(page)
@@ -129,7 +129,7 @@ class PageAllocator:
             raise ValueError(f"retain of unallocated page {page}")
         self._refs[page] = refs + 1
 
-    def release(self, page: int) -> bool:
+    def release(self, page: int) -> bool:  # graftcheck: runs-on(loop)
         """Drop a reference; returns True when the page went back to
         the free list (last reference gone)."""
         page = int(page)
@@ -146,7 +146,7 @@ class PageAllocator:
         self.counters["frees"] += 1
         return True
 
-    def reset(self) -> None:
+    def reset(self) -> None:  # graftcheck: runs-on(loop)
         """Forget every allocation (watchdog restart rebuilds the
         device carry from scratch — stale refs would leak the pool)."""
         self._free = list(

@@ -88,7 +88,7 @@ class PageLease:
         self._pool = pool
         self._released = False
 
-    def release(self) -> None:
+    def release(self) -> None:  # graftcheck: runs-on(loop)
         if self._released:
             return
         self._released = True
@@ -116,17 +116,19 @@ class PagePool:
         # inactive rows map GRAVE everywhere: a retired (or never-used)
         # slot's frozen cursor still receives each dispatch's K/V write
         # — the graveyard absorbs it; NULL must stay all-zero
-        self.tables = np.full(
+        self.tables = np.full(  # guarded_by: loop [writes]
             (self.max_slots, self.max_pages), GRAVE_PAGE, np.int32
         )
         # (s_bucket, start_pad) -> [_RegistryEntry]: placement key first
         # (sharing is placement-exact), then a short best-common-prefix
         # scan inside the bucket
-        self._registry: Dict[Tuple[int, int], List[_RegistryEntry]] = {}
-        self._clock = 0
-        self._leases = 0
-        self._lease_refs: Dict[int, int] = {}
-        self.counters = {
+        self._registry: Dict[Tuple[int, int], List[_RegistryEntry]] = (  # guarded_by: loop [writes]
+            {}
+        )
+        self._clock = 0  # guarded_by: loop [writes]
+        self._leases = 0  # guarded_by: loop [writes]
+        self._lease_refs: Dict[int, int] = {}  # guarded_by: loop [writes]
+        self.counters = {  # guarded_by: loop [writes]
             "registry_hits": 0, "registry_misses": 0,
             "registry_evictions": 0, "shared_mappings": 0,
         }
@@ -205,7 +207,7 @@ class PagePool:
         span_end: int,
         shared: Optional[PageLease] = None,
         alloc_end: Optional[int] = None,
-    ) -> Tuple[np.ndarray, np.ndarray, int]:
+    ) -> Tuple[np.ndarray, np.ndarray, int]:  # graftcheck: runs-on(loop)
         """Compose a slot's table row for insert.  Returns ``(row,
         write_mask, cow_forks)``: ``row`` is the (max_pages,) int32
         table entries, ``write_mask`` marks the pages the insert
@@ -242,10 +244,10 @@ class PagePool:
         self.counters["shared_mappings"] += shared_n
         return row, mask, forks
 
-    def commit_slot_row(self, slot: int, row: np.ndarray) -> None:
+    def commit_slot_row(self, slot: int, row: np.ndarray) -> None:  # graftcheck: runs-on(loop)
         self.tables[slot] = row
 
-    def extend_slot_row(self, slot: int, p0: int, p1: int) -> np.ndarray:
+    def extend_slot_row(self, slot: int, p0: int, p1: int) -> np.ndarray:  # graftcheck: runs-on(loop)
         """LAZY decode-page growth: allocate private pages for table
         positions [p0, p1) of a COMMITTED slot row (they must be NULL
         — beyond the row's allocated frontier, inside its span) and
@@ -263,14 +265,14 @@ class PagePool:
         row[p0:p1] = fresh
         return row.copy()
 
-    def release_row(self, row: Sequence[int]) -> None:
+    def release_row(self, row: Sequence[int]) -> None:  # graftcheck: runs-on(loop)
         """Release an UNCOMMITTED row's references (an admission that
         built its row and then failed before commit)."""
         for p in row:
             if int(p) >= RESERVED_PAGES:
                 self.alloc.release(int(p))
 
-    def free_slot(self, slot: int) -> None:
+    def free_slot(self, slot: int) -> None:  # graftcheck: runs-on(loop)
         """Release a retired slot's page references and park the row on
         the graveyard (the device table row must be repointed BEFORE
         any freed page can be re-allocated — the engine sequences the
@@ -286,7 +288,7 @@ class PagePool:
     # ------------------------------------------------------------ registry
 
     def registry_register(self, s_bucket: int, start_pad: int,
-                          ids: Sequence[int], row: np.ndarray) -> bool:
+                          ids: Sequence[int], row: np.ndarray) -> bool:  # graftcheck: runs-on(loop)
         """Pin a freshly-inserted slot's PROMPT-prefix pages under the
         placement key.  Only pages fully below the decode span are
         registered (``boundary = (s_bucket // T) * T``): their bytes
@@ -322,7 +324,7 @@ class PagePool:
         return True
 
     def registry_lookup(self, s_bucket: int, start_pad: int,
-                        ids: Sequence[int]) -> Optional[PageLease]:
+                        ids: Sequence[int]) -> Optional[PageLease]:  # graftcheck: runs-on(loop)
         """Best common-prefix match at this exact placement, as a
         retained :class:`PageLease` — or None when no entry shares at
         least one full page of prompt prefix.  The lease's pages stay
@@ -357,7 +359,7 @@ class PagePool:
         self._leases += 1
         return PageLease(self, best.entries, best_k, boundary)
 
-    def _evict_lru(self) -> None:
+    def _evict_lru(self) -> None:  # graftcheck: runs-on(loop)
         lru_key, lru_i = None, -1
         lru_clock = None
         for key, bucket in self._registry.items():
@@ -374,7 +376,7 @@ class PagePool:
                 self.alloc.release(p)
         self.counters["registry_evictions"] += 1
 
-    def reclaim(self, need_free: int) -> int:
+    def reclaim(self, need_free: int) -> int:  # graftcheck: runs-on(loop)
         """Evict LRU registry entries until ``need_free`` pages are
         free (or the registry is empty).  Returns entries evicted.
         Only registry pins are reclaimable — slot-table references are
@@ -409,7 +411,7 @@ class PagePool:
 
     # ----------------------------------------------------------- lifecycle
 
-    def reset(self) -> None:
+    def reset(self) -> None:  # graftcheck: runs-on(loop)
         """Watchdog-restart path: the device carry was rebuilt from
         scratch (fresh zero pages), so every mapping here is stale."""
         self.alloc.reset()

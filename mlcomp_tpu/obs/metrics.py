@@ -87,7 +87,7 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
         # labelvalues tuple -> state (float, or histogram triple)
-        self._values: Dict[Tuple[str, ...], Any] = {}
+        self._values: Dict[Tuple[str, ...], Any] = {}  # guarded_by: _lock
 
     def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
         if set(labels) != set(self.labelnames):
@@ -222,9 +222,10 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}  # insertion-ordered
-        self._collectors: List[Callable[[], None]] = []
-        self._collector_errors = 0
+        # insertion-ordered
+        self._metrics: Dict[str, _Metric] = {}  # guarded_by: _lock
+        self._collectors: List[Callable[[], None]] = []  # guarded_by: _lock
+        self._collector_errors = 0  # guarded_by: _lock
 
     def _get_or_create(self, cls, name: str, help: str, labelnames, **kw):
         with self._lock:

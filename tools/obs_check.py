@@ -98,11 +98,18 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_prefix_cache_lookups_total",
     "mlcomp_prefix_cache_hits_total",
     "mlcomp_prefix_cache_misses_total",
+    "mlcomp_prefix_cache_matched_tokens_total",
+    "mlcomp_prefix_cache_used_hits_total",
     "mlcomp_prefix_cache_used_hit_tokens_total",
     "mlcomp_prefix_cache_inserted_tokens_total",
     "mlcomp_prefix_cache_evictions_total",
+    "mlcomp_prefix_cache_evicted_tokens_total",
+    "mlcomp_prefix_cache_insert_errors_total",
+    "mlcomp_prefix_cache_insert_dropped_total",
     "mlcomp_prefix_cache_bytes",
+    "mlcomp_prefix_cache_max_bytes",
     "mlcomp_prefix_cache_nodes",
+    "mlcomp_prefix_cache_pinned_nodes",
     "mlcomp_prefix_cache_outstanding_leases",
     "mlcomp_prefix_cache_capture_queue_depth",
 ]
