@@ -1109,6 +1109,91 @@ def bench_engine(scan_variants=None) -> "dict | None":
             ),
         }
 
+    # OBSERVABILITY-SPINE A/B (cluster observability PR): the serve
+    # daemon now runs a metrics-history sampler thread (a registry
+    # snapshot every --metrics-history-interval, default 5 s, feeding
+    # the SLO burn-rate engine) and mints/threads a W3C trace id per
+    # request.  Same contract as the recorder and resilience blocks:
+    # always-on costs nothing — gate <1% of dispatch wall.  Arm A is
+    # the bare dispatch loop; arm B runs it with the sampler ticking at
+    # a 50 ms cadence (100x the production rate, so the A/B has a
+    # prayer of seeing the cost through tunnel noise) while a trace id
+    # is minted per dispatch (fatter than reality: ids are per
+    # REQUEST).  The direct tie-breakers price one sampler tick as a
+    # duty cycle at the DEFAULT 5 s cadence plus one id mint per
+    # dispatch.
+    if _block_on("MLCOMP_BENCH_SKIP_OBS_SPINE"):
+        from mlcomp_tpu.obs.history import MetricsHistory
+        from mlcomp_tpu.utils.trace import make_trace_id
+
+        eng8 = engines[8]
+        reset_fleet(eng8)
+        walls_s = {"on": [], "off": []}
+        n_disp = 3
+        hist = None
+        try:
+            for w in range(WINDOWS):
+                order = ("off", "on") if w % 2 == 0 else ("on", "off")
+                for mode in order:
+                    if mode == "on" and hist is None:
+                        hist = MetricsHistory(
+                            eng8.metrics, interval_s=0.05,
+                        )
+                    if mode == "off" and hist is not None:
+                        hist.close()
+                        hist = None
+                    t0 = time.perf_counter()
+                    for _ in range(n_disp):
+                        if mode == "on":
+                            make_trace_id()
+                        eng8._run_dispatch()
+                    walls_s[mode].append(
+                        (time.perf_counter() - t0) / n_disp
+                    )
+        finally:
+            if hist is not None:
+                hist.close()
+        s_on = statistics.median(walls_s["on"]) * 1e3
+        s_off = statistics.median(walls_s["off"]) * 1e3
+        delta_s = statistics.median(
+            (a - b) * 1e3 for a, b in zip(walls_s["on"], walls_s["off"])
+        )
+        s_pct = delta_s / s_off * 100 if s_off > 0 else 0.0
+        # direct costs: one registry snapshot (the whole sampler tick)
+        # and one trace-id mint, timed straight — the honest
+        # tie-breakers when tunnel drift swamps the A/B
+        hist = MetricsHistory(eng8.metrics, interval_s=3600.0,
+                              start=False)
+        n_ops = 200
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            hist.sample_now()
+        per_sample_ms = (time.perf_counter() - t0) / n_ops * 1e3
+        hist.close()
+        # at the default 5 s cadence the sampler's duty cycle — the
+        # fraction of EVERY wall-clock second it occupies, dispatching
+        # or not — is per-sample cost / 5000 ms
+        duty_pct = per_sample_ms / 5000.0 * 100
+        n_ops = 20000
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            make_trace_id()
+        per_id_ms = (time.perf_counter() - t0) / n_ops * 1e3
+        id_pct = per_id_ms / s_off * 100 if s_off > 0 else 0.0
+        line["obs_spine"] = {
+            "dispatch_wall_ms": {"spine_on": round(s_on, 3),
+                                 "spine_off": round(s_off, 3)},
+            "paired_delta_ms": round(delta_s, 3),
+            "overhead_pct": round(s_pct, 3),
+            "per_sample_ms": round(per_sample_ms, 4),
+            "sampler_duty_pct_at_default_interval": round(duty_pct, 4),
+            "per_trace_id_ms": round(per_id_ms, 6),
+            "trace_id_pct_of_dispatch": round(id_pct, 4),
+            "within_1pct_budget": bool(
+                s_pct < 1.0 or (duty_pct + id_pct) < 1.0
+            ),
+        }
+
     # BATCHED speculative engine (round 5, opt-in spec_k): one
     # per-row-cursor verify per dispatch — tokens/dispatch = 8 rows x
     # acceptance.  Weights are untrained so acceptance is the

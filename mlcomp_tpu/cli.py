@@ -336,6 +336,26 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             print(f"error: --mesh expects AXIS=N[,AXIS=N...], got"
                   f" {args.mesh!r}", file=sys.stderr)
             return 2
+    slo_config = None
+    if args.slo_config:
+        if not args.metrics_history_interval:
+            print("error: --slo-config needs the metrics-history "
+                  "sampler; don't combine it with "
+                  "--metrics-history-interval 0", file=sys.stderr)
+            return 2
+        try:
+            with open(args.slo_config) as f:
+                slo_config = json.load(f)
+            # semantic validation HERE — before the expensive model
+            # build/restore — so a bad config gets the same clean
+            # error/exit-2 path a JSON syntax error does
+            from mlcomp_tpu.obs.slo import validate_config
+
+            validate_config(slo_config)
+        except (OSError, ValueError) as e:
+            print(f"error: --slo-config {args.slo_config!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
     service = load_service(
         model_cfg,
         ckpt_dir=ckpt,
@@ -373,6 +393,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         kv_page_tokens=args.kv_page_tokens,
         kv_pages=args.kv_pages,
         max_slots=args.max_slots,
+        metrics_history_interval=args.metrics_history_interval,
+        slo_config=slo_config,
     )
     if args.warmup:
         n = service.warmup()
@@ -728,6 +750,21 @@ def main(argv=None) -> int:
         " drive loop is provably dead) attempts one bounded restart."
         " Set well above your slowest legitimate dispatch (compile"
         " stalls count!); 0 disables the watchdog",
+    )
+    sv.add_argument(
+        "--metrics-history-interval", type=float, default=5.0,
+        help="seconds between metrics-history snapshots (the bounded"
+        " ring behind GET /metrics/history and the SLO engine's burn"
+        " rates; default 5).  0 disables the sampler — /metrics/history"
+        " and /slo answer 404",
+    )
+    sv.add_argument(
+        "--slo-config", default=None, metavar="FILE.json",
+        help="JSON file overriding the default SLOs (TTFT p95,"
+        " per-token p50, reject rate, engine-healthy uptime) and their"
+        " windows/budgets — see docs/observability.md 'SLOs and burn"
+        " rates'.  Malformed config fails startup, not the first"
+        " evaluation",
     )
     sv.add_argument("--warmup", action="store_true",
                     help="precompile the hot buckets before listening")

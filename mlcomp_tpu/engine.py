@@ -126,7 +126,12 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mlcomp_tpu.utils.faults import inject as _inject_fault
-from mlcomp_tpu.utils.trace import Tracer, null_tracer
+from mlcomp_tpu.utils.trace import (
+    Tracer,
+    make_trace_id,
+    null_tracer,
+    valid_trace_id,
+)
 
 _POISON = object()  # close() wakes a blocked queue.get with this
 
@@ -861,6 +866,7 @@ class DecodeEngine:
         repetition_penalty: float = 1.0,
         stream: Optional["queue.Queue"] = None,
         deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
         _count: bool = True,
     ) -> Future:
         ids = [int(t) for t in prompt_ids]
@@ -895,6 +901,19 @@ class DecodeEngine:
             raise ValueError(
                 f"deadline_s must be positive, got {deadline_s}"
             )
+        # W3C-style trace context: every request carries a 32-hex trace
+        # id from submit to finish — minted here unless the caller
+        # (the HTTP layer inheriting a client's ``traceparent``)
+        # supplies one.  The id rides the request object into every
+        # flight-recorder span the request touches and is echoed in
+        # the response, so one id follows a request across daemons.
+        if trace_id is None:
+            trace_id = make_trace_id()
+        elif not valid_trace_id(trace_id):
+            raise ValueError(
+                f"trace_id must be 32 lowercase hex chars (W3C trace "
+                f"context), got {trace_id!r}"
+            )
         fut: Future = Future()
         # request-lifecycle trace: one async span per request
         # (queue -> admit -> first_token -> finish), correlated by rid.
@@ -902,9 +921,11 @@ class DecodeEngine:
         # they stay out of every other request-visible counter.
         rid = next(self._rid) if _count else 0
         fut.rid = rid  # the cancel(rid) handle callers key on
+        fut.trace_id = trace_id  # echoed on every response path
         if rid:
             self.recorder.async_begin(
                 "request", rid, cat="req", prompt=len(ids), n_new=n_new,
+                trace_id=trace_id,
             )
         now = time.perf_counter()
         self._queue.put({
@@ -923,6 +944,7 @@ class DecodeEngine:
                 None if deadline_s is None else now + float(deadline_s)
             ),
             "rid": rid,
+            "trace_id": trace_id,
             # warmup's dummy prompts must not seed (or probe) the prefix
             # cache — they'd pin budget with [1]*bucket junk
             "warmup": not _count,
@@ -2511,9 +2533,10 @@ class DecodeEngine:
         # chunks' total stall.  Overlapping the upload with dispatches
         # (an extra admission state) is the open follow-up.
         rid = req.get("rid", 0)
+        tid = req.get("trace_id")
         if rid:
             self.recorder.async_instant(
-                "admit", rid, cat="req", bucket=s_bucket,
+                "admit", rid, cat="req", bucket=s_bucket, trace_id=tid,
             )
         hit_tokens = 0
         cache_faulted = False
@@ -2537,7 +2560,7 @@ class DecodeEngine:
             try:
                 with self.recorder.span(
                     "kv_registry.lookup", track="engine.loop",
-                    prompt=len(ids), rid=rid,
+                    prompt=len(ids), rid=rid, trace_id=tid,
                 ) as sp:
                     _inject_fault("cache.lookup")
                     lease = self._pool.registry_lookup(
@@ -2600,7 +2623,7 @@ class DecodeEngine:
             try:
                 with self.recorder.span(
                     "prefix_cache.lookup", track="engine.loop",
-                    prompt=len(ids), rid=rid,
+                    prompt=len(ids), rid=rid, trace_id=tid,
                 ) as sp:
                     lease = self.prefix_cache.lookup(ids)
                     if lease is not None:
@@ -2667,6 +2690,7 @@ class DecodeEngine:
                 "prefill_chunk", track="engine.loop",
                 chunk=adm.next_chunk, of=adm.n_chunks,
                 rid=adm.req.get("rid", 0), fused=False,
+                trace_id=adm.req.get("trace_id"),
             ):
                 logits, adm.cache = self._prefill_chunk_fn(c)(
                     self.variables, adm.cache,
@@ -3201,7 +3225,7 @@ class DecodeEngine:
         try:
             with self.recorder.span(
                 "insert", track="engine.loop", slot=slot,
-                rid=req.get("rid", 0),
+                rid=req.get("rid", 0), trace_id=req.get("trace_id"),
             ):
                 self._dstate = self._insert_fn()(
                     self._dstate, adm.cache, adm.last_logits,
@@ -3288,6 +3312,10 @@ class DecodeEngine:
             "ids": [t for t, _ in sl.emitted],
             "latency_ms": round((now - req["t_submit"]) * 1e3, 2),
             "batched_with": self.slots,
+            # echo the request's trace id: the client can hand it to
+            # GET /trace?trace_id= (or the fleet merger) to pull
+            # exactly this request's spans
+            "trace_id": req.get("trace_id"),
         }
         if self.prefix_cache is not None:
             # per-request accounting: prompt tokens whose prefill the
@@ -3341,6 +3369,7 @@ class DecodeEngine:
                         "prefill_chunk", track="engine.loop",
                         chunk=adm.next_chunk, of=adm.n_chunks,
                         rid=adm.req.get("rid", 0), fused=True, seq=seq,
+                        trace_id=adm.req.get("trace_id"),
                     ):
                         (self._dstate, packed, logits,
                          adm.cache) = self._fused_dispatch_fn(adm.chunk)(

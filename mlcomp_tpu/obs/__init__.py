@@ -1,13 +1,16 @@
 """Observability: dependency-free metrics registry (Prometheus text
-exposition) and the dependency-free xplane reader behind device-time
+exposition), the metrics-history ring + SLO burn-rate engine built on
+it, and the dependency-free xplane reader behind device-time
 attribution.
 
-Both modules are stdlib-only by design — the serving daemon and report
-server must be scrapeable without a prometheus_client install, and the
-device-profile path (``GET /profile``, ``obs.devprof``) must parse
-``jax.profiler`` xplane captures without a TensorFlow install (the
-container bakes nothing in).  ``devprof`` is imported lazily by its
-consumers, never here — the metrics hot path must not pay for it.
+All modules are stdlib-only by design — the serving daemon and report
+server must be scrapeable (and now trend/SLO-queryable via
+``/metrics/history`` and ``/slo``) without a prometheus_client
+install, and the device-profile path (``GET /profile``,
+``obs.devprof``) must parse ``jax.profiler`` xplane captures without a
+TensorFlow install (the container bakes nothing in).  ``devprof``,
+``history``, and ``slo`` are imported lazily by their consumers, never
+here — the metrics hot path must not pay for them.
 """
 
 from mlcomp_tpu.obs.metrics import (  # noqa: F401

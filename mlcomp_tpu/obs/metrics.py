@@ -113,6 +113,27 @@ class _Metric:
                 for k, v in sorted(self._values.items())
             ]
 
+    def data(self) -> Dict[Tuple[str, ...], Any]:
+        """Point-in-time copy of the per-labelset state: floats for
+        counters/gauges, ``[bucket_counts, sum, count]`` triples for
+        histograms — the structured read behind ``Registry.snapshot``
+        (the metrics-history sampler), where text exposition would
+        force a parse round trip."""
+        with self._lock:
+            return {
+                k: (
+                    [list(v[0]), float(v[1]), int(v[2])]
+                    if isinstance(v, list) else float(v)
+                )
+                for k, v in self._values.items()
+            }
+
+    def label_key(self, key: Tuple[str, ...]) -> str:
+        """``name{a="b",...}`` sample-name formatting for a labelset
+        key (matches the text exposition, so history/SLO consumers can
+        correlate JSON keys with scraped series)."""
+        return f"{self.name}{self._label_str(key)}"
+
 
 class Counter(_Metric):
     """Monotonically non-decreasing count."""
@@ -265,7 +286,21 @@ class Registry:
         with self._lock:
             self._collectors.append(fn)
 
-    def render(self) -> str:
+    def unregister_collector(self, fn: Callable[[], None]) -> None:
+        """Remove a registered collector (no-op if absent): a closed
+        component (a MetricsHistory sampler) must not keep publishing
+        frozen values — or pin itself alive — through a registry that
+        outlives it."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
+    def collect(self) -> None:
+        """Run every registered collector once (error-contained) so the
+        instruments hold fresh values — the shared first half of
+        ``render`` and ``snapshot``."""
         with self._lock:
             collectors = list(self._collectors)
         for fn in collectors:
@@ -281,6 +316,31 @@ class Registry:
                 "mlcomp_metrics_collector_errors_total",
                 "Collector callbacks that raised during a scrape",
             ).set_total(errs)
+
+    def snapshot(self, run_collectors: bool = True
+                 ) -> Dict[str, Dict[str, Any]]:
+        """Structured point-in-time read of every family: name ->
+        ``{"kind", "labelnames", "buckets" (histograms), "values"}``
+        where values maps labelset tuples to floats or histogram
+        ``[counts, sum, count]`` triples.  The metrics-history sampler
+        reads this instead of parsing the text exposition."""
+        if run_collectors:
+            self.collect()
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {
+            m.name: {
+                "kind": m.kind,
+                "labelnames": m.labelnames,
+                "buckets": list(getattr(m, "buckets", ())) or None,
+                "values": m.data(),
+                "label_key": m.label_key,
+            }
+            for m in metrics
+        }
+
+    def render(self) -> str:
+        self.collect()
         with self._lock:
             metrics = list(self._metrics.values())
         lines: List[str] = []

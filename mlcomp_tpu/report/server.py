@@ -16,6 +16,21 @@ Endpoints (all JSON unless noted):
 - ``GET /metrics``                          Prometheus text exposition:
   DAG/task status counts, worker heartbeat ages, plus the proxied
   serve-daemon stats as scrapeable series (docs/observability.md)
+- ``GET /fleet/trace``                      ONE merged Perfetto trace
+  across every daemon in ``MLCOMP_TPU_SERVE_URLS`` (comma-separated
+  base URLs; falls back to ``MLCOMP_TPU_SERVE_URL``): each daemon's
+  ``/trace`` export lands under its own pid with a ``process_name``
+  metadata record, timestamps aligned onto the report server's clock
+  (per-daemon skew estimated from the scrape RTT midpoint), so a
+  request's prefill on one replica renders against its neighbors.
+  Forwards ``last_ms`` / ``trace_id`` to the daemons — a trace id
+  minted on one daemon filters the whole fleet's view (``rid`` is NOT
+  forwarded: rids are per-daemon counters, so one rid names a
+  different request on every daemon)
+- ``GET /fleet/metrics``                    one text exposition merging
+  every daemon's ``/metrics`` with a ``daemon="host:port"`` label per
+  sample (plus ``mlcomp_fleet_daemon_up``), so one scrape target
+  compares replicas
 
 Each request opens its own Store handle (sqlite connections are not
 thread-safe across the ThreadingHTTPServer pool; WAL mode makes the
@@ -42,6 +57,211 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 
 from mlcomp_tpu.db.store import Store
+
+# ---------------------------------------------------------------- fleet
+# The serving control plane's sight line (ROADMAP item 4's
+# prerequisite): the report server scrapes every daemon in
+# MLCOMP_TPU_SERVE_URLS and serves ONE merged Perfetto trace and ONE
+# labeled metrics exposition, so a fleet of engine replicas is
+# debuggable from a single pane before the scheduler ever manages one.
+
+
+def _fleet_urls() -> "list[str]":
+    """Daemon base URLs behind the /fleet surfaces: the comma-separated
+    ``MLCOMP_TPU_SERVE_URLS`` list, falling back to the single-daemon
+    ``MLCOMP_TPU_SERVE_URL`` the /api/serving proxy already uses."""
+    raw = os.environ.get("MLCOMP_TPU_SERVE_URLS", "")
+    urls = [u.strip().rstrip("/") for u in raw.split(",") if u.strip()]
+    if not urls:
+        single = os.environ.get("MLCOMP_TPU_SERVE_URL", "").rstrip("/")
+        if single:
+            urls = [single]
+    return urls
+
+
+def _daemon_name(base: str) -> str:
+    """``host:port`` — the ``daemon`` label value and process name."""
+    return base.split("://", 1)[-1]
+
+
+def _fetch_daemon(base: str, path: str, timeout: float = 3.0) -> bytes:
+    import urllib.request
+
+    headers = {}
+    token = os.environ.get("MLCOMP_TPU_SERVE_TOKEN", "")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(base + path, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _fetch_fleet(urls: "list[str]", fetch_one):
+    """Run ``fetch_one(base)`` for every daemon CONCURRENTLY (stdlib
+    thread pool), results in ``urls`` order.  The per-daemon timeout is
+    3 s; serial scraping would make one dead daemon cost the whole
+    fleet surface 3 s and an N-daemon fleet sum-of-RTTs."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(len(urls), 16)) as pool:
+        return list(pool.map(fetch_one, urls))
+
+
+def merge_fleet_trace(urls: "list[str]", query: str = "") -> dict:
+    """Scrape each daemon's ``/trace`` and merge into one Chrome-trace
+    body: one pid per daemon (named via ``process_name`` metadata), all
+    timestamps mapped onto the REPORT SERVER's wall clock.
+
+    Alignment: every daemon export is stamped with its wall clock and
+    recorder clock read back to back (``clock_offset_us`` — see
+    ``Tracer.export``), which maps events onto that daemon's unix time;
+    the residual cross-host clock skew is estimated per scrape as the
+    difference between the daemon's export stamp and this server's
+    clock at the scrape's RTT MIDPOINT (the export happens roughly
+    mid-request, so the midpoint is the unbiased read).  Good to ~RTT/2
+    — read adjacency across daemons, not exact edges."""
+    def fetch_one(base):
+        # t0/t1 bracket THIS daemon's request on its own worker thread
+        # — the RTT midpoint skew estimate needs the per-daemon pair,
+        # not the pool's overall completion time
+        t0 = time.time()
+        try:
+            body = json.loads(_fetch_daemon(
+                base, "/trace" + (f"?{query}" if query else "")
+            ))
+        except Exception as e:
+            return t0, time.time(), None, e
+        return t0, time.time(), body, None
+
+    events: list = []
+    daemons: list = []
+    fetched = _fetch_fleet(urls, fetch_one)
+    for i, (base, (t0, t1, body, err)) in enumerate(zip(urls, fetched)):
+        pid = i + 1
+        info: dict = {"url": base, "pid": pid, "name": _daemon_name(base)}
+        if err is not None:
+            info["error"] = f"{type(err).__name__}: {err}"
+            daemons.append(info)
+            continue
+        od = body.get("otherData") or {}
+        offset = od.get("clock_offset_us")
+        exp_unix = od.get("export_unix_us")
+        mid_us = (t0 + t1) / 2 * 1e6
+        skew_us = (exp_unix - mid_us) if exp_unix is not None else 0.0
+        evs = body.get("traceEvents") or []
+        info.update({
+            "rtt_ms": round((t1 - t0) * 1e3, 2),
+            "clock_skew_us": round(skew_us, 1),
+            "dropped_events": od.get("dropped_events"),
+            "events": len(evs),
+        })
+        for e in evs:
+            e = dict(e)
+            e["pid"] = pid
+            if offset is not None and "ts" in e:
+                # daemon recorder clock -> daemon unix -> our unix
+                e["ts"] = float(e["ts"]) + offset - skew_us
+            events.append(e)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": _daemon_name(base)},
+        })
+        daemons.append(info)
+    # rebase onto the earliest event so Perfetto opens at t=0 instead
+    # of an epoch-sized offset
+    ts_vals = [e["ts"] for e in events if "ts" in e]
+    t_base = min(ts_vals) if ts_vals else 0.0
+    for e in events:
+        if "ts" in e:
+            e["ts"] = e["ts"] - t_base
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"daemons": daemons, "t0_unix_us": t_base},
+    }
+
+
+_FLEET_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$"
+)
+_FLEET_SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
+
+
+def merge_fleet_metrics(urls: "list[str]") -> str:
+    """Scrape each daemon's ``/metrics`` and merge into one exposition
+    with a ``daemon="host:port"`` label injected into every sample.
+    Families are grouped (one HELP/TYPE block per family, samples from
+    all daemons contiguous under it — the 0.0.4 grouping rule), and
+    ``mlcomp_fleet_daemon_up`` reports which daemons answered."""
+    fams: dict = {}
+
+    def fam_entry(name: str) -> dict:
+        return fams.setdefault(
+            name, {"help": None, "type": None, "samples": []}
+        )
+
+    def fetch_one(base):
+        try:
+            return _fetch_daemon(base, "/metrics").decode()
+        except Exception:
+            return None
+
+    up: list = []
+    for base, text in zip(urls, _fetch_fleet(urls, fetch_one)):
+        daemon = _daemon_name(base)
+        if text is None:
+            up.append((daemon, 0))
+            continue
+        up.append((daemon, 1))
+        types: dict = {}
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP "):
+                parts = line.split(" ", 3)
+                if len(parts) == 4:
+                    e = fam_entry(parts[2])
+                    if e["help"] is None:
+                        e["help"] = parts[3]
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split(" ")
+                if len(parts) == 4:
+                    types[parts[2]] = parts[3]
+                    e = fam_entry(parts[2])
+                    if e["type"] is None:
+                        e["type"] = parts[3]
+                continue
+            if line.startswith("#"):
+                continue
+            m = _FLEET_SAMPLE_RE.match(line)
+            if not m:
+                continue
+            name, labels, value = m.group(1), m.group(2), m.group(3)
+            stripped = _FLEET_SUFFIX_RE.sub("", name)
+            fam = stripped if stripped in types else name
+            dl = f'daemon="{daemon}"'
+            if labels:
+                relabeled = f"{name}{{{dl},{labels[1:-1]}}} {value}"
+            else:
+                relabeled = f"{name}{{{dl}}} {value}"
+            fam_entry(fam)["samples"].append(relabeled)
+    lines: list = [
+        "# HELP mlcomp_fleet_daemon_up 1 when the daemon's /metrics "
+        "answered this fleet scrape",
+        "# TYPE mlcomp_fleet_daemon_up gauge",
+    ]
+    for daemon, ok in up:
+        lines.append(f'mlcomp_fleet_daemon_up{{daemon="{daemon}"}} {ok}')
+    for name, e in fams.items():
+        if not e["samples"]:
+            continue
+        if e["help"]:
+            lines.append(f"# HELP {name} {e['help']}")
+        lines.append(f"# TYPE {name} {e['type'] or 'untyped'}")
+        lines.extend(e["samples"])
+    return "\n".join(lines) + "\n"
+
 
 _POST_ROUTES = [
     (re.compile(r"^/api/dags/(\d+)/stop$"), "stop_dag"),
@@ -461,7 +681,7 @@ class _Handler(BaseHTTPRequestHandler):
         return hmac.compare_digest(auth, f"Bearer {secret}")
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path in ("/", "/index.html"):
             # static shell only — every datum it shows comes from the
             # token-checked API routes below (the page forwards ?token=
@@ -472,6 +692,66 @@ class _Handler(BaseHTTPRequestHandler):
         # report payloads are as sensitive as the mutation routes
         if not self._token_ok():
             self._json({"error": "invalid or missing token"}, code=403)
+            return
+        if path in ("/fleet/trace", "/fleet/metrics"):
+            # fleet surfaces never touch the store — they scrape the
+            # configured serve daemons
+            urls = _fleet_urls()
+            if not urls:
+                self._json({
+                    "error": "no serve daemons configured: set "
+                    "MLCOMP_TPU_SERVE_URLS (comma-separated base "
+                    "URLs) or MLCOMP_TPU_SERVE_URL",
+                }, code=404)
+                return
+            try:
+                if path == "/fleet/metrics":
+                    from mlcomp_tpu.obs.metrics import CONTENT_TYPE
+
+                    body = merge_fleet_metrics(urls).encode()
+                    self._send(200, body, CONTENT_TYPE)
+                    return
+                from urllib.parse import parse_qs, urlencode
+
+                from mlcomp_tpu.utils.trace import valid_trace_id
+
+                qs = parse_qs(query)
+                # validate BEFORE the fan-out: a malformed filter must
+                # be a 400 here, not N daemon 400s silently merged
+                # into an empty-but-200 trace
+                params = {}
+                if qs.get("last_ms"):
+                    try:
+                        last_ms = float(qs["last_ms"][0])
+                    except ValueError:
+                        last_ms = -1.0
+                    if last_ms <= 0:
+                        self._json({
+                            "error": "last_ms must be a positive "
+                            f"number, got {qs['last_ms'][0]!r}",
+                        }, code=400)
+                        return
+                    params["last_ms"] = qs["last_ms"][0]
+                if qs.get("trace_id"):
+                    tid = qs["trace_id"][0].strip().lower()
+                    if not valid_trace_id(tid):
+                        self._json({
+                            "error": "trace_id must be 32 hex chars, "
+                            f"got {qs['trace_id'][0]!r}",
+                        }, code=400)
+                        return
+                    params["trace_id"] = tid
+                # last_ms and trace_id forward fleet-wide; rid does NOT
+                # — rids are per-daemon monotonic counters, so one rid
+                # names a DIFFERENT request on every daemon and the
+                # merged "filtered" view would conflate them.  The
+                # trace id is the globally-unique key; per-daemon rid
+                # filtering belongs on that daemon's own /trace.
+                self._json(merge_fleet_trace(urls, urlencode(params)))
+            except Exception as e:  # surface, don't kill the thread
+                self._json(
+                    {"error": f"{type(e).__name__}: {e}"}, code=500
+                )
             return
         if path == "/metrics":
             # Prometheus text, not JSON — rendered outside _dispatch

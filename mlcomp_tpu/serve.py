@@ -69,7 +69,22 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
         trace-event JSON (Perfetto-loadable): dispatch issue/resolve
         spans, in-flight dispatch async spans, prefill chunks,
         prefix-cache lookups/captures, per-request lifecycle spans
-        (404 for batchers without a drive loop to record)
+        (404 for batchers without a drive loop to record).
+        ``?trace_id=<32 hex>`` / ``?rid=N`` restrict the export to ONE
+        request's events — the id every response echoes (requests
+        inherit the client's W3C ``traceparent`` trace id, or mint
+        one at submit)
+    GET  /slo -> declarative SLO status (mlcomp_tpu/obs/slo.py):
+        fast/slow-window burn rates, breach state, and the live
+        windowed measurement per objective (TTFT p95, per-token p50,
+        reject rate, engine-healthy uptime by default;
+        ``--slo-config`` overrides).  404 when the history sampler is
+        disabled (``--metrics-history-interval 0``)
+    GET  /metrics/history?window_s=N -> the bounded metrics-history
+        ring (mlcomp_tpu/obs/history.py) as JSON: per-interval counter
+        deltas, gauge points, and materialized histogram quantiles —
+        rate/trend queries with no external Prometheus.  404 when
+        disabled
     GET  /profile?dispatches=N -> arm a windowed jax.profiler capture
         around the next N dispatch boundaries, parse the xplane with
         the dependency-free reader (obs/devprof.py) and answer with
@@ -100,6 +115,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mlcomp_tpu.engine import DeadlineExceeded, _fail_future
+from mlcomp_tpu.utils.trace import (
+    filter_export,
+    make_trace_id,
+    parse_traceparent,
+    valid_trace_id,
+)
 
 
 class BackpressureError(RuntimeError):
@@ -189,6 +210,8 @@ class GenerationService:
         kv_page_tokens: Optional[int] = None,
         kv_pages: Optional[int] = None,
         max_slots: Optional[int] = None,
+        metrics_history_interval: Optional[float] = 5.0,
+        slo_config: Optional[Dict[str, Any]] = None,
     ):
         import jax
 
@@ -319,6 +342,37 @@ class GenerationService:
         # daemon, whatever the batcher
         self.metrics = Registry()
         self.metrics.register_collector(self._collect_metrics)
+        # observability spine: the metrics-history sampler thread
+        # (GET /metrics/history) and the SLO burn-rate engine
+        # (GET /slo) built on it.  The SLO config is validated HERE —
+        # before the engine spins up any threads — so a malformed
+        # --slo-config fails construction with a clear message instead
+        # of surfacing at the first evaluation tick.
+        self.history = None
+        self.slo = None
+        self._history_interval = (
+            float(metrics_history_interval)
+            if metrics_history_interval else 0.0
+        )
+        if self._history_interval < 0:
+            raise ValueError(
+                f"metrics_history_interval must be >= 0 (0 disables), "
+                f"got {metrics_history_interval}"
+            )
+        # keep the RAW override for SLOEngine (validate_config is how
+        # it learns which SLOs are disabled — feeding it an already-
+        # validated config would re-merge the defaults and resurrect
+        # them); the early call exists purely to fail fast
+        self._slo_config = slo_config
+        if self._history_interval > 0:
+            from mlcomp_tpu.obs.slo import validate_config
+
+            validate_config(slo_config)
+        elif slo_config is not None:
+            raise ValueError(
+                "slo_config needs the metrics-history sampler; don't "
+                "set metrics_history_interval to 0 with an SLO config"
+            )
         self._stop = threading.Event()
         # batcher selection: "continuous" (default, mesh or not) =
         # token-granularity slot engine (mlcomp_tpu/engine.py): requests
@@ -461,6 +515,25 @@ class GenerationService:
             self.engine = None
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
+        if self._history_interval > 0:
+            from mlcomp_tpu.obs.history import MetricsHistory
+            from mlcomp_tpu.obs.slo import SLOEngine
+
+            self.history = MetricsHistory(
+                self.metrics, interval_s=self._history_interval,
+            )
+            self.slo = SLOEngine(
+                self.history, config=self._slo_config,
+                registry=self.metrics,
+                recorder=(
+                    self.engine.recorder
+                    if self.engine is not None else None
+                ),
+            )
+            # burn rates re-evaluate at every sampler tick — breaches
+            # flip (and record their flight-recorder instant) with or
+            # without scrape traffic
+            self.history.add_callback(self.slo.evaluate)
 
     # ------------------------------------------------------------- public
 
@@ -476,6 +549,7 @@ class GenerationService:
         repetition_penalty: Optional[float] = None,
         stream: Optional["queue.Queue"] = None,
         deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Enqueue one generation request; resolves to a list of the
         GENERATED ids (prompt excluded, truncated at the request's
@@ -497,7 +571,19 @@ class GenerationService:
         and the future fails with ``DeadlineExceeded`` (HTTP: 504).
         Admission control may reject BEFORE queueing with
         ``BackpressureError`` (HTTP: 429 + ``Retry-After``) when the
-        bounded queue or concurrency cap is hit."""
+        bounded queue or concurrency cap is hit.
+
+        ``trace_id`` (optional, any batcher): a W3C-shape 32-hex trace
+        id to adopt (the HTTP layer passes the client's ``traceparent``
+        id here); minted when absent.  The id is echoed in the result
+        and threads through every flight-recorder span the request
+        touches — ``GET /trace?trace_id=`` pulls exactly this
+        request's events."""
+        if trace_id is not None and not valid_trace_id(trace_id):
+            raise ValueError(
+                f"trace_id must be 32 lowercase hex chars (W3C trace "
+                f"context), got {trace_id!r}"
+            )
         ids = [int(t) for t in prompt_ids]
         if not ids:
             raise ValueError("prompt must be non-empty")
@@ -584,7 +670,7 @@ class GenerationService:
             return self.engine.submit(
                 ids, n_new, temperature=t, top_k=k, top_p=p, eos_id=eos,
                 logprobs=logprobs, repetition_penalty=rp, stream=stream,
-                deadline_s=eff_deadline,
+                deadline_s=eff_deadline, trace_id=trace_id,
             )
         if stream is not None:
             raise ValueError(
@@ -598,6 +684,11 @@ class GenerationService:
             )
         self._stats["requests"] += 1
         fut: Future = Future()
+        # window/speculative requests carry a trace id too — no
+        # flight recorder to thread it through, but the response echo
+        # keeps the cross-daemon contract uniform
+        tid = trace_id if trace_id is not None else make_trace_id()
+        fut.trace_id = tid
         self._queue.put({
             "ids": ids, "n_new": n_new, "bucket_new": nb, "future": fut,
             "temperature": t,
@@ -606,6 +697,7 @@ class GenerationService:
             "eos_id": -1 if eos is None else eos,
             "logprobs": bool(logprobs),
             "repetition_penalty": rp,
+            "trace_id": tid,
         })
         return fut
 
@@ -889,6 +981,12 @@ class GenerationService:
                 out["kv_pool"] = eng["kv_pool"]
                 out["live_slots"] = eng.get("live_slots")
             out["engine"] = eng
+        if self.slo is not None:
+            # the SLO verdict rides /healthz: which objectives are
+            # burning budget and how fast, without a second fetch
+            out["slo"] = self.slo.summary()
+        if self.history is not None:
+            out["metrics_history"] = self.history.stats()
         return out
 
     def cache_stats(self) -> Optional[Dict[str, Any]]:
@@ -937,16 +1035,44 @@ class GenerationService:
                 "Requests waiting for a batch",
             ).set(self._queue.qsize() + len(self._deferred))
 
-    def trace(self, last_ms: Optional[float] = None) -> Dict[str, Any]:
+    def trace(self, last_ms: Optional[float] = None,
+              trace_id: Optional[str] = None,
+              rid: Optional[int] = None) -> Dict[str, Any]:
         """The engine flight recorder's Chrome-trace export (behind
-        GET /trace).  Raises for batchers without a drive loop to
-        record — the HTTP layer maps that to a 404."""
+        GET /trace).  ``trace_id`` / ``rid`` restrict the export to one
+        request's events (lifecycle span, admission spans, cache/
+        registry lookups, insert).  Raises for batchers without a drive
+        loop to record — the HTTP layer maps that to a 404."""
         if self.engine is None:
             raise ValueError(
                 "the flight recorder needs the continuous batcher; "
                 f"this service runs the {self.batcher} batcher"
             )
-        return self.engine.recorder.export(last_ms=last_ms)
+        body = self.engine.recorder.export(last_ms=last_ms)
+        if trace_id is not None or rid is not None:
+            body = filter_export(body, trace_id=trace_id, rid=rid)
+        return body
+
+    def slo_status(self) -> Dict[str, Any]:
+        """The SLO engine's full status (behind GET /slo).  Raises when
+        the history sampler is disabled — HTTP maps that to 404."""
+        if self.slo is None:
+            raise ValueError(
+                "SLOs need the metrics-history sampler; this service "
+                "was built with metrics_history_interval=0"
+            )
+        return self.slo.status()
+
+    def metrics_history(self, window_s: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """The metrics-history ring as JSON (behind
+        GET /metrics/history).  Raises when disabled — HTTP 404."""
+        if self.history is None:
+            raise ValueError(
+                "metrics history is disabled; this service was built "
+                "with metrics_history_interval=0"
+            )
+        return self.history.query(window_s=window_s)
 
     def profile(self, dispatches: int = 8) -> Future:
         """Arm an on-demand device-profile capture (behind
@@ -971,6 +1097,10 @@ class GenerationService:
 
     def close(self) -> None:
         self._stop.set()
+        if self.history is not None:
+            # stop the sampler (and with it the SLO evaluation
+            # callbacks) before tearing the engine down
+            self.history.close()
         if self.engine is not None:
             self.engine.close()
         if self._thread is not None:
@@ -1186,6 +1316,7 @@ class GenerationService:
             "ids": gen,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 2),
             "batched_with": 1,
+            "trace_id": item.get("trace_id"),
         })
 
     def _run_batch(self, batch: List[Dict[str, Any]]) -> None:  # graftcheck: runs-on(batcher)
@@ -1234,7 +1365,8 @@ class GenerationService:
         for r, item in enumerate(batch):
             gen = _trim_generated(out[r], s_bucket, item)
             result = {"ids": gen, "latency_ms": round(latency_ms, 2),
-                      "batched_with": len(batch)}
+                      "batched_with": len(batch),
+                      "trace_id": item.get("trace_id")}
             if item.get("logprobs"):
                 result["logprobs"] = [
                     round(float(v), 5) for v in lps[r, : len(gen)]
@@ -1424,10 +1556,61 @@ def make_http_server(
                             raise ValueError(
                                 f"last_ms must be positive, got {last_ms}"
                             )
-                    return self._json(service.trace(last_ms=last_ms))
+                    trace_id = None
+                    if qs.get("trace_id"):
+                        trace_id = qs["trace_id"][0].strip().lower()
+                        if not valid_trace_id(trace_id):
+                            raise ValueError(
+                                f"trace_id must be 32 hex chars, got "
+                                f"{qs['trace_id'][0]!r}"
+                            )
+                    rid = None
+                    if qs.get("rid"):
+                        rid = int(qs["rid"][0])
+                        if rid <= 0:
+                            raise ValueError(
+                                f"rid must be positive, got {rid}"
+                            )
+                    return self._json(service.trace(
+                        last_ms=last_ms, trace_id=trace_id, rid=rid,
+                    ))
                 except ValueError as e:
                     return self._json(
                         {"error": f"{type(e).__name__}: {e}"}, 400
+                    )
+            if route == "/slo":
+                try:
+                    return self._json(service.slo_status())
+                except ValueError as e:
+                    # disabled sampler: absent surface, like /trace on
+                    # a window batcher
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 404
+                    )
+            if route == "/metrics/history":
+                from urllib.parse import parse_qs
+
+                try:
+                    qs = parse_qs(query)
+                    window_s = None
+                    if qs.get("window_s"):
+                        window_s = float(qs["window_s"][0])
+                        if window_s <= 0:
+                            raise ValueError(
+                                f"window_s must be positive, got "
+                                f"{window_s}"
+                            )
+                except ValueError as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 400
+                    )
+                try:
+                    return self._json(
+                        service.metrics_history(window_s=window_s)
+                    )
+                except ValueError as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 404
                     )
             if route == "/profile":
                 from urllib.parse import parse_qs
@@ -1541,6 +1724,10 @@ def make_http_server(
                 status = getattr(e, "status", None)
                 err = json.dumps({
                     "error": f"{type(e).__name__}: {e}",
+                    # the id is echoed on EVERY response path, and a
+                    # failed stream is exactly where the client needs
+                    # it to pull the request's spans from /trace
+                    "trace_id": getattr(fut, "trace_id", None),
                     **({"status": status} if status else {}),
                 })
                 try:
@@ -1554,6 +1741,15 @@ def make_http_server(
                 return self._json({"error": "invalid or missing token"}, 403)
             if self.path.split("?", 1)[0] != "/generate":
                 return self._json({"error": "not found"}, 404)
+            # trace context: inherit the client's W3C ``traceparent``
+            # trace id when one arrives well-formed, mint otherwise —
+            # EVERY response path below (result, 4xx/5xx error bodies)
+            # echoes the id, so a client can always hand it to
+            # GET /trace?trace_id= (or the report server's fleet
+            # merger) and pull this request's spans
+            tid = parse_traceparent(self.headers.get("traceparent"))
+            if tid is None:
+                tid = make_trace_id()
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
@@ -1570,6 +1766,7 @@ def make_http_server(
                     repetition_penalty=req.get("repetition_penalty"),
                     stream=toks,
                     deadline_s=req.get("deadline_s"),
+                    trace_id=tid,
                 )
                 if want_stream:
                     return self._stream(fut, toks)
@@ -1586,6 +1783,7 @@ def make_http_server(
                     "error": str(e), "status": "rejected",
                     "reason": e.reason,
                     "retry_after_s": round(e.retry_after_s, 1),
+                    "trace_id": tid,
                 }).encode()
                 self.send_response(429)
                 self.send_header("Content-Type", "application/json")
@@ -1599,14 +1797,17 @@ def make_http_server(
             except (DeadlineExceeded, FutTimeout) as e:
                 return self._json(
                     {"error": f"{type(e).__name__}: {e}",
-                     "status": "deadline_exceeded"}, 504,
+                     "status": "deadline_exceeded", "trace_id": tid}, 504,
                 )
             except (KeyError, ValueError, TypeError) as e:
-                return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "trace_id": tid}, 400,
+                )
             except Exception as e:
                 status = getattr(e, "status", None)
                 return self._json(
-                    {"error": f"{type(e).__name__}: {e}",
+                    {"error": f"{type(e).__name__}: {e}", "trace_id": tid,
                      **({"status": status} if status else {})}, 500,
                 )
 

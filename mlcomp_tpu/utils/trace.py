@@ -38,11 +38,106 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
+
+# W3C trace-context shapes (https://www.w3.org/TR/trace-context/):
+# a trace id is 32 lowercase hex chars, not all-zero; a traceparent
+# header is ``version-traceid-parentid-flags``.  The serving path mints
+# one per request at submit (or inherits the client's via the
+# ``traceparent`` header) and threads it through every span the request
+# touches, so one id follows a request across daemons.
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+_HEX_RE = re.compile(r"^[0-9a-f]+$")
+
+
+def make_trace_id() -> str:
+    """A fresh W3C-shape trace id (32 hex chars, never all-zero)."""
+    while True:
+        tid = os.urandom(16).hex()
+        if tid != "0" * 32:
+            return tid
+
+
+def valid_trace_id(tid: Any) -> bool:
+    # fullmatch, not match: '$' would accept a trailing newline, which
+    # then embeds verbatim in span args and can never be matched by
+    # the (stripped) ?trace_id= filter
+    return isinstance(tid, str) and bool(
+        _TRACE_ID_RE.fullmatch(tid)
+    ) and tid != "0" * 32
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[str]:
+    """The trace id out of a ``traceparent`` header, or None when the
+    header is absent/malformed (a bad header must not fail the request
+    — the daemon just mints a fresh id)."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    ver, tid, parent = parts[0], parts[1], parts[2]
+    if len(ver) != 2 or not _HEX_RE.match(ver) or ver == "ff":
+        return None
+    if not valid_trace_id(tid):
+        return None
+    if len(parent) != 16 or not _HEX_RE.match(parent) or (
+        parent == "0" * 16
+    ):
+        return None
+    return tid
+
+
+def filter_export(body: Dict[str, Any], trace_id: Optional[str] = None,
+                  rid: Optional[int] = None) -> Dict[str, Any]:
+    """Restrict a Chrome-trace export body to ONE request's events —
+    the ``GET /trace?trace_id=`` / ``?rid=`` filters.
+
+    Request-lifecycle async events correlate by ``cat="req"`` with the
+    rid as their id; per-request spans (admit, prefix/registry lookups,
+    prefill chunks, insert) carry ``rid`` — and the lifecycle begin
+    carries ``trace_id`` — in their args.  A trace-id filter first
+    resolves the matching rid(s) from the lifecycle begins, then keeps
+    exactly the events either filter would: track metadata always,
+    ``cat="req"`` events whose id matches, and any event whose args
+    carry a matching rid or trace_id."""
+    evs = body.get("traceEvents", [])
+    rids = set()
+    if rid is not None:
+        rids.add(int(rid))
+    if trace_id is not None:
+        for e in evs:
+            if (e.get("cat") == "req" and e.get("ph") == "b"
+                    and (e.get("args") or {}).get("trace_id") == trace_id):
+                try:
+                    rids.add(int(e.get("id")))
+                except (TypeError, ValueError):
+                    pass
+    rid_strs = {str(r) for r in rids}
+    kept = []
+    for e in evs:
+        if e.get("ph") == "M":
+            kept.append(e)
+            continue
+        if e.get("cat") == "req" and e.get("id") in rid_strs:
+            kept.append(e)
+            continue
+        args = e.get("args") or {}
+        if args.get("rid") in rids or (
+            trace_id is not None and args.get("trace_id") == trace_id
+        ):
+            kept.append(e)
+    out = dict(body)
+    out["traceEvents"] = kept
+    other = dict(out.get("otherData") or {})
+    other["filter"] = {"trace_id": trace_id, "rids": sorted(rids)}
+    out["otherData"] = other
+    return out
 
 
 class Tracer:
@@ -226,12 +321,23 @@ class Tracer:
              "args": {"name": track}}
             for track, tid in sorted(tracks.items(), key=lambda kv: kv[1])
         ]
+        # shared clock contract: every export is stamped with the wall
+        # clock AND the recorder clock read back to back, so any
+        # consumer (the report server's fleet merger, an external
+        # trace store) can map event timestamps onto unix time —
+        # unix_us(event) = ts + clock_offset_us — without guessing
+        # which process epoch a windowed export came from.
+        export_unix_us = time.time() * 1e6
+        export_trace_us = self._now_us()
         return {
             "traceEvents": meta + evs,
             "displayTimeUnit": "ms",
             "otherData": {
                 "dropped_events": dropped,
                 "max_events": self.max_events,
+                "export_unix_us": export_unix_us,
+                "export_trace_us": export_trace_us,
+                "clock_offset_us": export_unix_us - export_trace_us,
             },
         }
 

@@ -119,7 +119,13 @@ def test_ring_buffer_evicts_oldest_and_counts_drops():
     assert [e["name"] for e in evs] == ["e2", "e3", "e4"]
     assert tr.dropped == 2
     body = tr.export()
-    assert body["otherData"] == {"dropped_events": 2, "max_events": 3}
+    other = body["otherData"]
+    assert other["dropped_events"] == 2 and other["max_events"] == 3
+    # every export carries the shared-clock stamps the fleet merger
+    # (and any external consumer) aligns on
+    assert other["clock_offset_us"] == (
+        other["export_unix_us"] - other["export_trace_us"]
+    )
 
 
 def test_export_last_ms_windows_and_metadata():
@@ -336,12 +342,26 @@ def test_trace_404_for_window_batcher():
         svc.close()
 
 
-def test_flight_recorder_can_be_disabled():
-    svc = _tiny_service(flight_recorder_events=0)
+def test_flight_recorder_and_history_can_be_disabled():
+    svc = _tiny_service(
+        flight_recorder_events=0, metrics_history_interval=0,
+    )
     try:
         svc.generate([5, 6, 7], 2)
         assert svc.engine.recorder.events == []
         assert svc.trace()["traceEvents"] == []
+        # history sampler off: the spine surfaces answer 404 (the
+        # service raises, the HTTP layer maps)
+        assert svc.history is None and svc.slo is None
+        with pytest.raises(ValueError):
+            svc.slo_status()
+        with pytest.raises(ValueError):
+            svc.metrics_history()
+        # an SLO config without the sampler it needs is a misconfig
+        with pytest.raises(ValueError):
+            _tiny_service(
+                metrics_history_interval=0, slo_config={},
+            )
     finally:
         svc.close()
 

@@ -1,0 +1,190 @@
+"""Metrics-history ring (mlcomp_tpu/obs/history.py): ring eviction,
+window queries, counter-reset clamping, quantile materialization, and
+sampler-thread shutdown — all against an injected clock, no jax."""
+
+import time
+
+import pytest
+
+from mlcomp_tpu.obs.history import MetricsHistory, bucket_quantile
+from mlcomp_tpu.obs.metrics import Registry
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_history(reg=None, **kw):
+    clock = kw.pop("clock", Clock())
+    kw.setdefault("interval_s", 5.0)
+    kw.setdefault("start", False)
+    return MetricsHistory(reg or Registry(), clock=clock, **kw), clock
+
+
+def test_ring_evicts_oldest_at_max_samples():
+    hist, clock = make_history(max_samples=3)
+    c = hist.registry.counter("t_requests_total", "")
+    for i in range(5):
+        c.inc()
+        clock.t += 5
+        hist.sample_now()
+    entries = hist.entries()
+    assert len(entries) == 3  # bounded
+    # the survivors are the NEWEST three (totals 3, 4, 5)
+    assert [e["counters"]["t_requests_total"] for e in entries] == [
+        3.0, 4.0, 5.0
+    ]
+    assert hist.stats()["samples_taken"] == 5
+    assert hist.stats()["samples_held"] == 3
+
+
+def test_window_query_trims_to_trailing_window():
+    hist, clock = make_history()
+    g = hist.registry.gauge("t_depth", "")
+    for i in range(6):
+        g.set(i)
+        clock.t += 10
+        hist.sample_now()
+    assert len(hist.entries()) == 6
+    # the last 25 s hold the samples taken at t-20, t-10, t-0
+    recent = hist.entries(window_s=25)
+    assert [e["gauges"]["t_depth"] for e in recent] == [3.0, 4.0, 5.0]
+    q = hist.query(window_s=25)
+    assert len(q["samples"]) == 3
+    assert q["samples"][-1]["age_s"] == 0.0
+    assert q["window_s"] == 25
+
+
+def test_counter_deltas_and_reset_clamp():
+    hist, clock = make_history()
+    c = hist.registry.counter("t_tokens_total", "")
+    c.inc(10)
+    hist.sample_now()
+    c.inc(7)
+    clock.t += 5
+    hist.sample_now()
+    deltas = [e["counter_deltas"]["t_tokens_total"] for e in hist.entries()]
+    # first sample has no predecessor: its whole total is the delta
+    assert deltas == [10.0, 7.0]
+    # simulate a restart: the counter steps BACKWARDS (a fresh process
+    # re-registered at a lower total).  The delta must clamp to the new
+    # value — rate() semantics — never go negative.
+    c._values[()] = 3.0
+    clock.t += 5
+    hist.sample_now()
+    assert hist.entries()[-1]["counter_deltas"]["t_tokens_total"] == 3.0
+    assert hist.window_delta("t_tokens_total") == 20.0
+
+
+def test_labeled_counters_keyed_like_the_exposition():
+    hist, clock = make_history()
+    c = hist.registry.counter("t_rej_total", "", labelnames=("reason",))
+    c.inc(2, reason="queue_full")
+    c.inc(1, reason="concurrency")
+    hist.sample_now()
+    e = hist.entries()[-1]
+    assert e["counters"]['t_rej_total{reason="queue_full"}'] == 2.0
+    assert e["counters"]['t_rej_total{reason="concurrency"}'] == 1.0
+
+
+def test_histogram_interval_quantiles_materialized():
+    hist, clock = make_history()
+    h = hist.registry.histogram(
+        "t_lat_ms", "", buckets=(10.0, 100.0, 1000.0)
+    )
+    for v in (5, 50, 50, 500):
+        h.observe(v)
+    hist.sample_now()
+    qs = hist.entries()[-1]["quantiles"]["t_lat_ms"]
+    # rank math over buckets [10, 100, 1000] with counts [1, 2, 1]:
+    # p50's rank 2 lands in the (10, 100] bucket
+    assert 10.0 < qs["p50"] <= 100.0
+    assert 100.0 < qs["p99"] <= 1000.0
+    # the NEXT interval has no observations -> quantiles are None, and
+    # the windowed aggregate still answers from the first interval
+    clock.t += 5
+    hist.sample_now()
+    assert hist.entries()[-1]["quantiles"]["t_lat_ms"]["p50"] is None
+    assert hist.window_quantile("t_lat_ms", 0.5) == qs["p50"]
+
+
+def test_quantile_mass_above_largest_bucket():
+    # observations past the last finite bound live only in the implicit
+    # +Inf count; the quantile must account for that mass and answer
+    # the largest finite bound for ranks inside it
+    assert bucket_quantile([10.0, 100.0], [0, 1], 0.99, total=10) == 100.0
+    assert bucket_quantile([10.0, 100.0], [0, 0], 0.5, total=0) is None
+
+
+def test_histogram_reset_clamp():
+    hist, clock = make_history()
+    h = hist.registry.histogram("t_lat_ms", "", buckets=(10.0, 100.0))
+    h.observe(5)
+    h.observe(5)
+    hist.sample_now()
+    # restart: fewer lifetime observations than the last sample saw
+    h._values[()] = [[1, 0], 5.0, 1]
+    clock.t += 5
+    hist.sample_now()
+    e = hist.entries()[-1]["hist"]["t_lat_ms"]
+    assert e["delta_n"] == 1 and e["delta_counts"] == [1, 0]
+
+
+def test_bad_construction_rejected():
+    with pytest.raises(ValueError):
+        MetricsHistory(Registry(), interval_s=0, start=False)
+    with pytest.raises(ValueError):
+        MetricsHistory(Registry(), max_samples=1, start=False)
+
+
+def test_callbacks_fire_and_errors_are_contained():
+    hist, clock = make_history()
+    seen = []
+    hist.add_callback(lambda: seen.append(1))
+    hist.add_callback(lambda: 1 / 0)
+    hist.sample_now()
+    hist.sample_now()
+    assert seen == [1, 1]
+    assert hist.stats()["callback_errors"] == 2
+
+
+def test_sampler_thread_samples_and_shuts_down():
+    reg = Registry()
+    reg.counter("t_total", "").inc()
+    hist = MetricsHistory(reg, interval_s=0.02, start=True)
+    deadline = time.time() + 5.0
+    while hist.stats()["samples_taken"] < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert hist.stats()["samples_taken"] >= 2
+    hist.close()
+    assert not hist._thread.is_alive()
+    taken = hist.stats()["samples_taken"]
+    time.sleep(0.06)
+    assert hist.stats()["samples_taken"] == taken  # really stopped
+
+
+def test_own_metrics_registered():
+    reg = Registry()
+    hist, clock = make_history(reg=reg)
+    hist.sample_now()
+    text = reg.render()
+    assert "mlcomp_metrics_history_samples_total 1" in text
+    assert "mlcomp_metrics_history_span_seconds" in text
+
+
+def test_close_unregisters_the_collector():
+    # regression: a registry can outlive its sampler (bench's obs_spine
+    # A/B churns them against one engine registry) — close() must
+    # deregister, or dead collectors accumulate and keep republishing
+    # frozen values
+    reg = Registry()
+    before = len(reg._collectors)
+    hist, _ = make_history(reg=reg)
+    assert len(reg._collectors) == before + 1
+    hist.close()
+    assert len(reg._collectors) == before
+    reg.render()  # and rendering after close is collector-free/clean

@@ -232,3 +232,40 @@ def test_declared_layout_round_trip(served):
     assert len([r for r in reps2 if r["name"] == "layout"]) == 1
     logs = " ".join(l["message"] for l in store.task_logs(tid))
     assert "layout rejected" in logs
+
+
+def test_fleet_surfaces_unconfigured_and_unreachable(served, monkeypatch):
+    """/fleet/trace + /fleet/metrics: 404 with no daemons configured;
+    with an unreachable daemon the merge degrades to an error entry /
+    an up=0 row instead of failing the whole scrape.  (The live
+    two-daemon merge is covered by tools/obs_check.py.)"""
+    import urllib.error
+
+    _, _, _, port = served
+    monkeypatch.delenv("MLCOMP_TPU_SERVE_URLS", raising=False)
+    monkeypatch.delenv("MLCOMP_TPU_SERVE_URL", raising=False)
+    for path in ("/fleet/trace", "/fleet/metrics"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, path)
+        assert ei.value.code == 404
+
+    monkeypatch.setenv("MLCOMP_TPU_SERVE_URLS", "http://127.0.0.1:1")
+    # malformed filters 400 at the report server BEFORE the fan-out —
+    # not N daemon 400s silently merged into an empty 200
+    for bad in ("/fleet/trace?trace_id=GARBAGE",
+                "/fleet/trace?last_ms=-5",
+                "/fleet/trace?last_ms=nope"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, bad)
+        assert ei.value.code == 400, bad
+    code, body = _get(port, "/fleet/trace")
+    assert code == 200
+    fleet = json.loads(body)
+    assert fleet["traceEvents"] == []
+    (d,) = fleet["otherData"]["daemons"]
+    assert d["name"] == "127.0.0.1:1" and "error" in d
+    code, body = _get(port, "/fleet/metrics")
+    assert code == 200
+    assert 'mlcomp_fleet_daemon_up{daemon="127.0.0.1:1"} 0' in (
+        body.decode()
+    )

@@ -1,10 +1,21 @@
-"""Span tracer: Chrome trace output + Trainer integration."""
+"""Span tracer: Chrome trace output + Trainer integration, plus the
+trace-context helpers (W3C trace ids, traceparent parsing, export
+clock stamps, per-request export filtering)."""
 
 import json
+import time
 
 import pytest
 
-from mlcomp_tpu.utils.trace import Tracer, get_tracer, set_tracer
+from mlcomp_tpu.utils.trace import (
+    Tracer,
+    filter_export,
+    get_tracer,
+    make_trace_id,
+    parse_traceparent,
+    set_tracer,
+    valid_trace_id,
+)
 
 
 def test_spans_and_counters_roundtrip(tmp_path):
@@ -43,6 +54,73 @@ def test_set_get_tracer():
     assert get_tracer() is tr
     set_tracer(None)
     assert get_tracer() is not tr
+
+
+def test_make_and_validate_trace_ids():
+    tid = make_trace_id()
+    assert valid_trace_id(tid) and len(tid) == 32
+    assert make_trace_id() != tid  # 128 random bits
+    assert not valid_trace_id("0" * 32)   # all-zero is reserved
+    assert not valid_trace_id("XY" * 16)  # hex only
+    assert not valid_trace_id(tid[:-1])   # length
+    assert not valid_trace_id(tid + "\n")  # '$' would accept this
+    assert not valid_trace_id(None)
+
+
+def test_parse_traceparent():
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    good = f"00-{tid}-00f067aa0ba902b7-01"
+    assert parse_traceparent(good) == tid
+    assert parse_traceparent(good.upper()) == tid  # case-insensitive
+    # malformed headers yield None (mint instead), never raise
+    for bad in (None, "", "garbage", f"ff-{tid}-00f067aa0ba902b7-01",
+                f"00-{'0' * 32}-00f067aa0ba902b7-01",
+                f"00-{tid}-{'0' * 16}-01", f"00-{tid}"):
+        assert parse_traceparent(bad) is None
+
+
+def test_export_carries_clock_stamps():
+    tr = Tracer()
+    with tr.span("x"):
+        pass
+    before = time.time() * 1e6
+    body = tr.export()
+    after = time.time() * 1e6
+    od = body["otherData"]
+    assert before <= od["export_unix_us"] <= after
+    # the offset maps any event ts onto unix time
+    ev = body["traceEvents"][0]
+    unix = ev["ts"] + od["clock_offset_us"]
+    assert abs(unix - od["export_unix_us"]) < 10e6
+
+
+def test_filter_export_by_trace_id_and_rid():
+    tid = make_trace_id()
+    tr = Tracer()
+    tr.async_begin("request", 7, cat="req", trace_id=tid)
+    tr.async_instant("admit", 7, cat="req")
+    with tr.span("insert", track="engine.loop", rid=7, trace_id=tid):
+        pass
+    # a neighbor request and request-agnostic engine spans
+    tr.async_begin("request", 8, cat="req", trace_id=make_trace_id())
+    with tr.span("issue", track="engine.loop", seq=1):
+        pass
+    tr.async_end("request", 7, cat="req")
+    body = tr.export()
+    by_tid = filter_export(body, trace_id=tid)
+    non_meta = [e for e in by_tid["traceEvents"] if e["ph"] != "M"]
+    assert [e["name"] for e in non_meta] == [
+        "request", "admit", "insert", "request"
+    ]
+    assert by_tid["otherData"]["filter"]["rids"] == [7]
+    # rid filter selects the same set; track metadata survives both
+    by_rid = filter_export(body, rid=7)
+    assert [e["name"] for e in by_rid["traceEvents"] if e["ph"] != "M"
+            ] == [e["name"] for e in non_meta]
+    assert any(e["ph"] == "M" for e in by_rid["traceEvents"])
+    # an unknown id filters everything request-scoped out
+    empty = filter_export(body, trace_id=make_trace_id())
+    assert [e for e in empty["traceEvents"] if e["ph"] != "M"] == []
 
 
 def test_trainer_writes_trace(tmp_path):

@@ -23,7 +23,18 @@ observability contract the docs promise (docs/observability.md):
   returns the device-time attribution contract (device_time_ms,
   host_gap_ms, kernel breakdown, per-family roofline utilization),
   and the ``/trace`` fetched AFTER it carries the merged
-  ``engine.device`` track aligned with the dispatch spans.
+  ``engine.device`` track aligned with the dispatch spans;
+- the observability SPINE: ``GET /slo`` answers the default
+  objectives' burn-rate/breach shape, ``GET /metrics/history`` serves
+  the ring with non-negative (reset-clamped) counter deltas that sum
+  to no more than the lifetime totals, a request that arrives with a
+  W3C ``traceparent`` echoes its trace id and
+  ``GET /trace?trace_id=`` / ``?rid=`` return exactly that request's
+  events;
+- the FLEET: a second toy daemon plus a report server scraping both
+  (``MLCOMP_TPU_SERVE_URLS``) serve ONE merged ``/fleet/trace`` with
+  one pid per daemon (named, clock-aligned) and one ``/fleet/metrics``
+  exposition with a ``daemon`` label per sample.
 
 No TPU needed (CPU jax), finishes in seconds; tests/test_obs_check.py
 wires it into tier-1 like tools/cachecheck.py.  Standalone:
@@ -112,6 +123,11 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_prefix_cache_pinned_nodes",
     "mlcomp_prefix_cache_outstanding_leases",
     "mlcomp_prefix_cache_capture_queue_depth",
+    "mlcomp_metrics_history_samples_total",
+    "mlcomp_metrics_history_span_seconds",
+    "mlcomp_slo_burn_rate",
+    "mlcomp_slo_breached",
+    "mlcomp_slo_breaches_total",
 ]
 
 _SAMPLE_RE = re.compile(
@@ -228,25 +244,32 @@ def run(n_requests: int = 3) -> dict:
         prompt_buckets=(16,), max_new_buckets=(8,),
         prefix_cache=True, prefill_chunk=8,
         kv_layout="paged", max_slots=4, kv_pages=2 + 64,
+        # a fast history cadence so the spine surfaces (/slo,
+        # /metrics/history, the mlcomp_slo_*/history families) carry
+        # real samples within this harness's lifetime
+        metrics_history_interval=0.25,
     )
     httpd = make_http_server(svc, "127.0.0.1", 0, "obs-check")
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     port = httpd.server_address[1]
     base = f"http://127.0.0.1:{port}"
 
-    def generate(ids, max_new=4):
+    def generate(ids, max_new=4, headers=None, at=None):
         body = json.dumps(
             {"prompt": ids, "max_new_tokens": max_new}
         ).encode()
         req = urllib.request.Request(
-            f"{base}/generate", data=body,
-            headers={"Content-Type": "application/json"},
+            f"{at or base}/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     **(headers or {})},
         )
         with urllib.request.urlopen(req, timeout=600) as r:
             return json.loads(r.read())
 
-    def get(path):
-        with urllib.request.urlopen(f"{base}{path}", timeout=60) as r:
+    def get(path, at=None):
+        with urllib.request.urlopen(
+            f"{at or base}{path}", timeout=60
+        ) as r:
             return r.read()
 
     try:
@@ -300,6 +323,11 @@ def run(n_requests: int = 3) -> dict:
         # assert the slot is free again)
         assert svc.engine._profile is None
 
+        # a deterministic history sample before the first scrape: the
+        # SLO gauges and history families materialize at the first
+        # sampler tick, and the documented-metric check below must see
+        # every family
+        svc.history.sample_now()
         text1 = get("/metrics").decode()
         s1, t1 = parse_exposition(text1)
         check_histograms(s1, t1)
@@ -393,6 +421,148 @@ def run(n_requests: int = 3) -> dict:
         # decode-time events the full fetch carried
         tiny = json.loads(get("/trace?last_ms=0.001"))
         assert len(tiny["traceEvents"]) <= len(evs)
+
+        # ---- observability spine: /slo against the default objectives
+        slo = json.loads(get("/slo"))
+        assert slo["evaluations"] >= 1, slo
+        assert set(slo["slos"]) == {
+            "ttft_p95", "per_token_p50", "reject_rate", "engine_healthy"
+        }, sorted(slo["slos"])
+        for name, st in slo["slos"].items():
+            assert set(st["burn_rate"]) == {"fast", "slow"}, (name, st)
+            assert all(v >= 0 for v in st["burn_rate"].values()), st
+            assert isinstance(st["breached"], bool), st
+        # nothing was rejected and the engine never went unhealthy:
+        # those objectives cannot be burning.  The toy LATENCY SLOs may
+        # legitimately breach (first-request compile TTFT blows a 2 s
+        # objective) — that is the burn math working, not a failure.
+        for name in ("reject_rate", "engine_healthy"):
+            assert not slo["slos"][name]["breached"], slo["slos"][name]
+        assert set(slo["breached"]) <= {"ttft_p95", "per_token_p50"}
+        hz = json.loads(get("/healthz"))
+        assert hz["slo"]["breached"] == slo["breached"], hz["slo"]
+        assert hz["metrics_history"]["samples_taken"] >= 1
+
+        # ---- /metrics/history: reset-clamped deltas vs lifetime totals
+        svc.history.sample_now()  # tail sample carrying today's traffic
+        hist = json.loads(get("/metrics/history?window_s=600"))
+        assert hist["samples"], hist
+        key = "mlcomp_engine_requests_total"
+        deltas = [s["counters"].get(key, 0.0) for s in hist["samples"]]
+        assert all(d >= 0 for d in deltas), deltas
+        assert 0 < sum(deltas) <= hist["totals"][key], (
+            deltas, hist["totals"].get(key)
+        )
+        assert any(
+            (s["quantiles"].get("mlcomp_engine_ttft_ms") or {}).get("p50")
+            is not None
+            for s in hist["samples"]
+        ), "no materialized TTFT quantile in any window sample"
+
+        # ---- trace-id propagation: inherit a traceparent, echo it,
+        #      filter the flight recorder down to that one request
+        tid = "0af7651916cd43dd8448eb211c80319c"
+        out = generate(shared + [240], headers={
+            "traceparent": f"00-{tid}-00f067aa0ba902b7-01",
+        })
+        assert out["trace_id"] == tid, out
+        filt = json.loads(get(f"/trace?trace_id={tid}"))
+        rids = filt["otherData"]["filter"]["rids"]
+        assert len(rids) == 1, rids
+        rid = rids[0]
+        non_meta = [e for e in filt["traceEvents"] if e["ph"] != "M"]
+        assert non_meta, "trace-id filter returned nothing"
+        for e in non_meta:
+            args = e.get("args") or {}
+            assert (
+                (e.get("cat") == "req" and e.get("id") == str(rid))
+                or args.get("rid") == rid
+                or args.get("trace_id") == tid
+            ), e
+        fnames = {e["name"] for e in non_meta}
+        assert {"request", "insert"} <= fnames, sorted(fnames)
+        by_rid = json.loads(get(f"/trace?rid={rid}"))
+        assert len(by_rid["traceEvents"]) == len(filt["traceEvents"])
+
+        # ---- the fleet: a second daemon + a report server scraping
+        #      both -> one merged Perfetto trace, one labeled
+        #      exposition
+        import tempfile
+
+        from mlcomp_tpu.report.server import start_in_thread
+
+        svc2 = GenerationService(
+            model, {"params": params}, batch_sizes=(1,),
+            prompt_buckets=(16,), max_new_buckets=(8,),
+            metrics_history_interval=0,
+        )
+        httpd2 = make_http_server(svc2, "127.0.0.1", 0, "obs-check-2")
+        threading.Thread(
+            target=httpd2.serve_forever, daemon=True
+        ).start()
+        base2 = f"http://127.0.0.1:{httpd2.server_address[1]}"
+        saved_env = {
+            k: os.environ.get(k)
+            for k in ("MLCOMP_TPU_SERVE_URLS", "MLCOMP_TPU_SERVE_URL")
+        }
+        report_srv = None
+        try:
+            generate([3, 4, 5, 6], at=base2)
+            os.environ["MLCOMP_TPU_SERVE_URLS"] = f"{base},{base2}"
+            report_srv, rport = start_in_thread(
+                tempfile.mktemp(suffix=".sqlite")
+            )
+            rbase = f"http://127.0.0.1:{rport}"
+            fleet = json.loads(get("/fleet/trace", at=rbase))
+            fevs = fleet["traceEvents"]
+            pids = {e["pid"] for e in fevs}
+            assert pids == {1, 2}, pids  # one pid per daemon
+            pnames = {
+                e["pid"]: e["args"]["name"] for e in fevs
+                if e["ph"] == "M" and e["name"] == "process_name"
+            }
+            assert len(pnames) == 2, pnames
+            for pid in (1, 2):
+                assert any(
+                    e["pid"] == pid and e["name"] == "issue"
+                    for e in fevs
+                ), f"daemon pid {pid} contributed no issue span"
+            # alignment: both daemons' events land on ONE clock —
+            # non-negative, and spanning no more than this harness's
+            # real lifetime (an unaligned epoch would be hours off)
+            ts = [e["ts"] for e in fevs if "ts" in e]
+            assert min(ts) >= 0 and max(ts) < 3600e6, (
+                min(ts), max(ts)
+            )
+            # the trace id minted on daemon 1 filters the WHOLE
+            # fleet's merged view down to that daemon's request
+            ffilt = json.loads(
+                get(f"/fleet/trace?trace_id={tid}", at=rbase)
+            )
+            fnm = [
+                e for e in ffilt["traceEvents"] if e["ph"] != "M"
+            ]
+            assert fnm and all(e["pid"] == 1 for e in fnm), fnm
+            ftext = get("/fleet/metrics", at=rbase).decode()
+            fs, ft = parse_exposition(ftext)
+            req_rows = fs["mlcomp_engine_requests_total"]
+            assert len(req_rows) == 2, req_rows  # one per daemon label
+            assert all("daemon=" in k for k in req_rows), req_rows
+            ups = fs["mlcomp_fleet_daemon_up"]
+            assert sorted(ups.values()) == [1.0, 1.0], ups
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            if report_srv is not None:
+                report_srv.shutdown()
+                report_srv.server_close()
+            httpd2.shutdown()
+            httpd2.server_close()
+            svc2.close()
+
         return {
             "requests": int(req1),
             "metric_families": len(t2),
@@ -401,6 +571,11 @@ def run(n_requests: int = 3) -> dict:
             "profile_dispatches": int(att["dispatches"]),
             "device_track_spans": len(dev_evs),
             "device_time_ms": att["device_time_ms"],
+            "slo_evaluations": int(slo["evaluations"]),
+            "history_samples": len(hist["samples"]),
+            "trace_filter_events": len(non_meta),
+            "fleet_daemons": len(pnames),
+            "fleet_trace_events": len(fevs),
         }
     finally:
         httpd.shutdown()
