@@ -7,9 +7,17 @@
   cache layouts) with device->host capture after prefill and
   host->device insert that respects the engine's per-row
   cursor/start/kv_mask contract.
+- ``prefix_key``: the pure, process-stable prompt-prefix key shared by
+  the trie and the fleet router's affinity routing (one definition of
+  "the same prefix" for both).
 
 See docs/prefix_cache.md for the design and its invariants.
 """
 
 from mlcomp_tpu.cache.kv_store import KVBlock, PrefixKVCache  # noqa: F401
 from mlcomp_tpu.cache.prefix_index import Lease, PrefixIndex  # noqa: F401
+from mlcomp_tpu.cache.prefix_key import (  # noqa: F401
+    normalize_ids,
+    prefix_hash,
+    rendezvous_rank,
+)

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from mlcomp_tpu.cache.prefix_key import normalize_ids
+
 
 def _common_prefix_len(a, b) -> int:
     n = min(len(a), len(b))
@@ -132,7 +134,11 @@ class PrefixIndex:
     def lookup(self, ids) -> Optional[Lease]:
         """Longest-prefix match of ``ids``; returns a pinned Lease or
         None on a zero-length match.  Touches the path for LRU."""
-        ids = tuple(int(t) for t in ids)
+        # the SHARED coercion (cache/prefix_key.py): the fleet router
+        # hashes the same normalized ids for prefix affinity, so a
+        # request routed by prefix lands on the replica whose trie
+        # walks these exact values
+        ids = normalize_ids(ids)
         with self._lock:
             self.counters["lookups"] += 1
             node, nodes, segments, matched = self._root, [], [], 0
@@ -175,7 +181,7 @@ class PrefixIndex:
         their rows need not ride along; if they were meanwhile evicted
         the insert declines (returns 0) rather than store a prefix with
         a hole."""
-        ids = tuple(int(t) for t in ids)
+        ids = normalize_ids(ids)
         offset = int(offset)
         if not ids or block is None or self.max_bytes <= 0:
             return 0

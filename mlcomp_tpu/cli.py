@@ -406,6 +406,143 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_fleet(args: argparse.Namespace) -> int:
+    """Run a managed replica fleet: N serve daemons reconciled by the
+    ReplicaManager behind the prefix-affinity router, optionally
+    autoscaled from SLO burn / reject-rate signals."""
+    import os
+    import threading
+    import time
+
+    from mlcomp_tpu.fleet import (
+        Autoscaler,
+        AutoscalePolicy,
+        ReplicaManager,
+        ReplicaSpec,
+        Router,
+        SchedulerLauncher,
+        SubprocessLauncher,
+        make_router_http_server,
+    )
+    from mlcomp_tpu.obs.metrics import Registry
+
+    if not args.ckpt and not args.storage_task:
+        print("error: pass --ckpt or --storage-task (a checkpoint to"
+              " serve)", file=sys.stderr)
+        return 2
+    try:
+        lo, hi = (int(x) for x in args.port_range.split(":"))
+    except ValueError:
+        print(f"error: --port-range expects LO:HI, got"
+              f" {args.port_range!r}", file=sys.stderr)
+        return 2
+    registry_path = os.path.abspath(args.registry)
+    max_replicas = args.max_replicas or max(
+        args.replicas, args.min_replicas
+    )
+    if args.scheduler:
+        import yaml
+
+        from mlcomp_tpu.db.store import Store
+
+        with open(args.model) as f:
+            doc = yaml.safe_load(f)
+        model_cfg = doc.get("model", doc) if isinstance(doc, dict) else doc
+        launcher = SchedulerLauncher(
+            Store(args.db), model_cfg, registry_path,
+            serve_args={
+                # --storage-task resolves ON THE WORKER (ModelStorage
+                # layouts are per-host); only an explicit --ckpt path
+                # is forwarded verbatim
+                "ckpt": args.ckpt,
+                "storage_task": args.storage_task,
+                "host": "auto", "warmup": True,
+            },
+            chips=args.chips,
+        )
+        port_range = None  # replicas bind ephemeral ports on their host
+    else:
+        serve_argv = ["--model", args.model]
+        if args.ckpt:
+            serve_argv += ["--ckpt", args.ckpt]
+        else:
+            serve_argv += ["--storage-task", args.storage_task]
+        serve_argv += ["--warmup"]
+        for extra in args.serve_arg:
+            serve_argv += extra.split()
+        launcher = SubprocessLauncher(
+            serve_argv, host=args.host, log_dir=args.log_dir,
+        )
+        port_range = (lo, hi)
+    metrics = Registry()
+    manager = ReplicaManager(
+        launcher,
+        ReplicaSpec(
+            target=args.replicas,
+            port_range=port_range,
+            health_poll_s=args.health_poll,
+            restart_budget=args.restart_budget,
+        ),
+        metrics=metrics,
+        registry_path=registry_path,
+    )
+    router = Router(manager=manager, metrics=metrics,
+                    health_poll_s=min(args.health_poll, 1.0))
+    scaler = None
+    stop = threading.Event()
+    threads = []
+    if args.autoscale or args.autoscale_dry_run:
+        scaler = Autoscaler(
+            AutoscalePolicy(
+                min_replicas=args.min_replicas,
+                max_replicas=max_replicas,
+            ),
+            manager=manager,
+            metrics=metrics,
+            dry_run=args.autoscale_dry_run,
+        )
+
+        def scale_loop():
+            while not stop.wait(args.autoscale_interval):
+                try:
+                    d = scaler.run_tick()
+                    if d["direction"] != "hold":
+                        print(json.dumps(
+                            {"event": "autoscale", **d}
+                        ), flush=True)
+                except Exception as e:
+                    print(json.dumps({
+                        "event": "autoscale_error", "error": str(e),
+                    }), flush=True)
+
+        threads.append(threading.Thread(target=scale_loop, daemon=True))
+    manager.start()
+    router.start()
+    httpd = make_router_http_server(router, args.host, args.port)
+    for t in threads:
+        t.start()
+    print(json.dumps({
+        "event": "fleet", "router": f"http://{args.host}:{args.port}",
+        "registry": registry_path, "replicas": args.replicas,
+        "autoscale": bool(scaler),
+        "dry_run": bool(scaler and scaler.dry_run),
+    }), flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        httpd.shutdown()
+        httpd.server_close()
+        router.close()
+        manager.close(stop_replicas=True)
+        # give subprocess replicas a beat to die before the registry
+        # file is left behind as state for the next incarnation
+        time.sleep(0.1)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="mlcomp-tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -769,6 +906,83 @@ def main(argv=None) -> int:
     sv.add_argument("--warmup", action="store_true",
                     help="precompile the hot buckets before listening")
     sv.set_defaults(fn=_cmd_serve)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="run a MANAGED replica fleet: N serve daemons reconciled"
+        " by the ReplicaManager (spawn, health-poll, bounded restart,"
+        " drain-on-scale-down) behind the prefix-affinity router, with"
+        " optional SLO-burn/reject-rate autoscaling"
+        " (docs/serving.md 'Running a fleet')",
+    )
+    fl.add_argument("--model", required=True,
+                    help="model YAML (same file `serve` takes)")
+    fl.add_argument("--ckpt", default=None, help="checkpoint directory")
+    fl.add_argument(
+        "--storage-task", default=None, metavar="PROJECT/DAG/TASK",
+        help="resolve the checkpoint from ModelStorage instead of"
+        " --ckpt",
+    )
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="initial replica target count")
+    fl.add_argument("--min-replicas", type=int, default=1,
+                    help="autoscaler floor")
+    fl.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscaler ceiling (default: --replicas)")
+    fl.add_argument(
+        "--port-range", default="8901:8999", metavar="LO:HI",
+        help="ports replicas are assigned from (subprocess launcher)",
+    )
+    fl.add_argument("--host", default="127.0.0.1",
+                    help="router bind host (replicas bind it too)")
+    fl.add_argument("--port", type=int, default=8900,
+                    help="router port — clients POST /generate here")
+    fl.add_argument(
+        "--registry", default="fleet-registry.json",
+        help="JSON replica registry file the manager maintains; point"
+        " the report server at it via MLCOMP_TPU_SERVE_REGISTRY for"
+        " live /fleet/trace + /fleet/metrics",
+    )
+    fl.add_argument("--health-poll", type=float, default=1.0,
+                    help="seconds between replica /healthz polls")
+    fl.add_argument(
+        "--restart-budget", type=int, default=3,
+        help="restarts per replica before the manager gives up on it"
+        " (refilled by sustained health — progress-gated like the"
+        " engine watchdog's own restart)",
+    )
+    fl.add_argument("--autoscale", action="store_true",
+                    help="drive the target count from SLO burn rates"
+                    " and admission-control reject ratios")
+    fl.add_argument(
+        "--autoscale-dry-run", action="store_true",
+        help="compute, log, and count autoscale decisions WITHOUT"
+        " applying them — stage the policy before handing it the lever",
+    )
+    fl.add_argument("--autoscale-interval", type=float, default=15.0,
+                    help="seconds between autoscaler scrape+decide"
+                    " ticks")
+    fl.add_argument(
+        "--scheduler", action="store_true",
+        help="launch replicas as long-lived scheduler tasks through"
+        " the --db store (any worker with the chips runs one; the"
+        " Supervisor requeues replicas whose worker dies) instead of"
+        " local child processes",
+    )
+    fl.add_argument("--db", default="mlcomp.sqlite",
+                    help="store for --scheduler mode")
+    fl.add_argument("--chips", type=int, default=0,
+                    help="chips per replica task (--scheduler mode)")
+    fl.add_argument(
+        "--serve-arg", action="append", default=[],
+        help="extra flag(s) appended to each replica's `serve` command"
+        " (repeatable; subprocess launcher only), e.g."
+        " --serve-arg '--prefix-cache'",
+    )
+    fl.add_argument("--log-dir", default=None,
+                    help="per-replica stdout/stderr logs (subprocess"
+                    " launcher)")
+    fl.set_defaults(fn=_cmd_fleet)
 
     args = p.parse_args(argv)
     from mlcomp_tpu.dag.graph import DagValidationError

@@ -20,7 +20,7 @@ def load_all() -> None:
     """
     import importlib
 
-    for mod in ("train", "infer", "kaggle"):
+    for mod in ("train", "infer", "kaggle", "serve"):
         name = f"mlcomp_tpu.executors.{mod}"
         try:
             importlib.import_module(name)

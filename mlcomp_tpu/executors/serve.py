@@ -1,0 +1,139 @@
+"""serve_replica executor: a serve daemon as a scheduler task.
+
+The missing piece between "the scheduler runs tasks" and "the fleet
+manager wants N replicas": a task that never finishes on purpose.  The
+fleet's :class:`~mlcomp_tpu.fleet.manager.SchedulerLauncher` submits
+one single-task DAG per replica with this executor; any Worker with the
+chips claims it, the daemon binds (ephemeral port by default, so many
+replicas pack one host), publishes its URL into the fleet registry
+file, and serves until the task is stopped — at which point it drains
+the HTTP server, deregisters, and returns a small stats result.
+
+Stop paths, in both execution modes:
+
+- **isolated child** (production): the worker's stop-watch kills the
+  child when ``store.stop_task`` flips the row — the OS teardown is the
+  drain.  The registry entry is left behind; the manager (or the next
+  incarnation's ``update_entry``) overwrites it, and the report
+  server's fleet surfaces mark the dead URL ``up 0`` meanwhile.
+- **in-process** (tests, ``isolate=False``): the executor polls its own
+  task row every ``stop_poll_s`` and exits cooperatively — the same
+  ownership re-check discipline long-running train executors use.
+
+Heartbeats keep flowing from the worker while the daemon serves, so
+the Supervisor's reaper only fires when the HOST actually dies — and
+then its standard requeue machinery restarts the replica on another
+worker, which re-publishes its (new) URL.  That is the whole multi-host
+restart story, bought with zero new scheduler code.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from mlcomp_tpu.dag.schema import TaskStatus
+from mlcomp_tpu.executors.base import ExecutionContext, Executor
+
+
+class ServeReplicaExecutor(Executor):
+    name = "serve_replica"
+
+    def work(self, ctx: ExecutionContext) -> Optional[Dict[str, Any]]:
+        from mlcomp_tpu.fleet.registry import remove_entry, update_entry
+        from mlcomp_tpu.serve import load_service, make_http_server
+
+        args = dict(ctx.args)
+        args.pop("code_src", None)
+        args.pop("code_import", None)
+        model_cfg = args.pop("model", None)
+        if not isinstance(model_cfg, dict):
+            raise ValueError(
+                "serve_replica needs a 'model' config mapping"
+            )
+        replica = str(args.pop("replica", ctx.task_name))
+        registry_path = args.pop("registry", None)
+        host = str(args.pop("host", "127.0.0.1"))
+        if host == "auto":
+            # the address OTHER hosts reach this worker at — the same
+            # resolution the gang coordinator rendezvous publishes
+            from mlcomp_tpu.scheduler.worker import host_address
+
+            host = host_address()
+        port = int(args.pop("port", 0))
+        ckpt = args.pop("ckpt", None)
+        storage_task = args.pop("storage_task", None)
+        if not ckpt and storage_task:
+            # resolve here, on the worker that will serve: the
+            # ModelStorage layout lives on this host, not wherever the
+            # fleet manager submitted the task from
+            from mlcomp_tpu.serve import resolve_storage_ckpt
+
+            parts = str(storage_task).split("/")
+            if len(parts) != 3:
+                raise ValueError(
+                    f"storage_task must be PROJECT/DAG/TASK, got "
+                    f"{storage_task!r}"
+                )
+            ckpt = resolve_storage_ckpt(*parts)
+        warmup = bool(args.pop("warmup", False))
+        stop_poll_s = float(args.pop("stop_poll_s", 1.0))
+        # remaining args pass straight into the GenerationService —
+        # the same knobs `mlcomp-tpu serve` exposes as flags
+        service = load_service(model_cfg, ckpt_dir=ckpt, **args)
+        httpd = None
+        url = None
+        try:
+            httpd = make_http_server(
+                service, host, port, str(model_cfg.get("name", "model"))
+            )
+            url = f"http://{host}:{httpd.server_address[1]}"
+            if registry_path:
+                # publish BEFORE warmup: the manager sees the URL and
+                # its health polls read ready=false until the compiles
+                # land — routed around, not restarted
+                update_entry(
+                    registry_path, replica, url=url, state="starting"
+                )
+            ctx.log(f"replica {replica} serving at {url}")
+            t = threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            )
+            t.start()
+            if warmup:
+                service.warmup()
+            while self._still_mine(ctx):
+                time.sleep(stop_poll_s)
+            ctx.log(f"replica {replica} stopping (task no longer ours)")
+            return {
+                "url": url,
+                "replica": replica,
+                **{k: service.stats().get(k)
+                   for k in ("requests", "healthy")},
+            }
+        finally:
+            if httpd is not None:
+                httpd.shutdown()
+                httpd.server_close()
+            service.close()
+            if registry_path and url is not None:
+                try:
+                    remove_entry(registry_path, replica)
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _still_mine(ctx: ExecutionContext) -> bool:
+        """The long-running executor's ownership re-check: keep serving
+        only while the task row is IN_PROGRESS under our worker — a
+        stop, a reap, or a re-claim all flip that within one poll."""
+        if ctx.store is None:
+            return True  # unit-test context without a store
+        try:
+            row = ctx.store.task_row(ctx.task_id)
+        except Exception:
+            return True  # a store hiccup must not kill the daemon
+        if row is None or row["status"] != TaskStatus.IN_PROGRESS.value:
+            return False
+        return ctx.worker is None or row["worker"] == ctx.worker

@@ -67,9 +67,20 @@ from mlcomp_tpu.db.store import Store
 
 
 def _fleet_urls() -> "list[str]":
-    """Daemon base URLs behind the /fleet surfaces: the comma-separated
-    ``MLCOMP_TPU_SERVE_URLS`` list, falling back to the single-daemon
+    """Daemon base URLs behind the /fleet surfaces.  The DYNAMIC
+    registry first: ``MLCOMP_TPU_SERVE_REGISTRY`` names the JSON file
+    the fleet ReplicaManager (and scheduler-launched replicas) keep
+    current, so replicas spawned/restarted/moved at runtime appear here
+    without an env edit.  The comma-separated ``MLCOMP_TPU_SERVE_URLS``
+    list is the static fallback, then the single-daemon
     ``MLCOMP_TPU_SERVE_URL`` the /api/serving proxy already uses."""
+    reg_path = os.environ.get("MLCOMP_TPU_SERVE_REGISTRY", "")
+    if reg_path:
+        from mlcomp_tpu.fleet.registry import registry_urls
+
+        urls = registry_urls(reg_path)
+        if urls:
+            return urls
     raw = os.environ.get("MLCOMP_TPU_SERVE_URLS", "")
     urls = [u.strip().rstrip("/") for u in raw.split(",") if u.strip()]
     if not urls:

@@ -191,10 +191,12 @@ def _free_port() -> int:
     return port
 
 
-def _host_address() -> str:
-    """Address other hosts can reach this one at (coordinator rendezvous).
-    Env override first (TPU-VM metadata scripts set it); localhost
-    fallback covers single-host and CPU-test topologies."""
+def host_address() -> str:
+    """Address other hosts can reach this one at (coordinator
+    rendezvous, and the URL a scheduler-launched serve replica
+    publishes into the fleet registry).  Env override first (TPU-VM
+    metadata scripts set it); localhost fallback covers single-host and
+    CPU-test topologies."""
     addr = os.environ.get("MLCOMP_TPU_HOST_IP")
     if addr:
         return addr
@@ -762,7 +764,7 @@ class Worker:
             # (see _bind_coordinator_socket).
             sock = _bind_coordinator_socket()
             self.store.publish_coordinator(
-                tid, f"{_host_address()}:{sock.getsockname()[1]}"
+                tid, f"{host_address()}:{sock.getsockname()[1]}"
             )
 
         handed_off = []

@@ -54,12 +54,19 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
         ``--max-concurrent-requests``) overload fast-fails with 429 +
         ``Retry-After`` derived from live per-token latency — see
         docs/serving.md "Failure semantics")
-    GET  /healthz   -> {"ok": true, "model": ..., "queue_depth": ...,
+    GET  /healthz   -> {"ok": true, "ready": true, "model": ...,
+                        "queue_depth": ...,
                         "latency": {p50/p95/p99 ttft + per-token ms},
                         "engine": {..., "pipeline": overlap metrics}}
         (503 with ``"ok": false`` while the engine watchdog reports
         the drive loop stalled/crashed; recovers after its bounded
-        restart)
+        restart.  ``ready`` is readiness, distinct from liveness:
+        false while warmup compiles run or the daemon is draining —
+        the fleet router routes around a not-ready replica without
+        the manager restarting it)
+    POST /drain     {"draining": true|false} -> flip readiness for the
+        scale-down handshake: a draining daemon finishes in-flight
+        work, stays ok, and advertises ready=false
     GET  /cache/stats -> prefix-cache hit/miss/eviction/byte counters
         (404 unless the service was built with ``prefix_cache=True``)
     GET  /metrics   -> Prometheus text exposition (mlcomp_tpu/obs):
@@ -373,6 +380,14 @@ class GenerationService:
                 "slo_config needs the metrics-history sampler; don't "
                 "set metrics_history_interval to 0 with an SLO config"
             )
+        # readiness vs liveness: ``ok`` (the watchdog verdict) answers
+        # "should the manager restart this replica"; ``ready`` answers
+        # "should the router send it traffic".  A daemon mid-warmup or
+        # deliberately draining is NOT ready but IS ok — killing it
+        # would be wrong, routing to it would be wrong, and one bit
+        # cannot express both.
+        self._draining = False
+        self._warming = False
         self._stop = threading.Event()
         # batcher selection: "continuous" (default, mesh or not) =
         # token-granularity slot engine (mlcomp_tpu/engine.py): requests
@@ -863,12 +878,30 @@ class GenerationService:
                 f"max_concurrent_requests={self.max_concurrent_requests}"
             ))
 
+    def set_draining(self, draining: bool) -> bool:
+        """Flip the drain bit (behind ``POST /drain``): a draining
+        daemon keeps serving in-flight work and answers ``/healthz``
+        200/ok, but advertises ``ready: false`` so the fleet router
+        routes new traffic elsewhere while the manager lets it finish —
+        the scale-down handshake."""
+        self._draining = bool(draining)
+        return self._draining
+
     def warmup(self) -> int:
         """Precompile the hot programs by RUNNING a dummy generation per
         bucket (jax.jit is lazy and AOT-lowered executables don't seed
         the jit call cache, so only a real call makes later requests
         hit compiled code): B=1 and the largest batch, largest prompt
-        bucket, per max_new bucket."""
+        bucket, per max_new bucket.  ``ready`` reads false for the
+        duration — a router polling mid-warmup routes around the
+        compiling replica instead of queueing behind its compiles."""
+        self._warming = True
+        try:
+            return self._warmup_inner()
+        finally:
+            self._warming = False
+
+    def _warmup_inner(self) -> int:
         import jax
         import jax.numpy as jnp
 
@@ -987,6 +1020,13 @@ class GenerationService:
             out["slo"] = self.slo.summary()
         if self.history is not None:
             out["metrics_history"] = self.history.stats()
+        # readiness is liveness minus "can take NEW traffic": warmup
+        # compiles and deliberate drains clear it without touching ok —
+        # the router reads ready, the manager reads ok
+        out["draining"] = self._draining
+        out["ready"] = bool(
+            out["healthy"] and not self._draining and not self._warming
+        )
         return out
 
     def cache_stats(self) -> Optional[Dict[str, Any]]:
@@ -1739,7 +1779,30 @@ def make_http_server(
         def do_POST(self):  # noqa: N802
             if not self._token_ok():
                 return self._json({"error": "invalid or missing token"}, 403)
-            if self.path.split("?", 1)[0] != "/generate":
+            route = self.path.split("?", 1)[0]
+            if route == "/drain":
+                # the scale-down handshake (fleet/manager.py): flip
+                # ready without touching ok, so routers stop sending
+                # new work while in-flight requests finish.  Body
+                # {"draining": false} un-drains.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    draining = req.get("draining", True)
+                    if not isinstance(draining, bool):
+                        raise ValueError(
+                            f"draining must be a JSON boolean, got "
+                            f"{draining!r}"
+                        )
+                except (ValueError, TypeError) as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 400
+                    )
+                return self._json(
+                    {"ok": True,
+                     "draining": service.set_draining(draining)}
+                )
+            if route != "/generate":
                 return self._json({"error": "not found"}, 404)
             # trace context: inherit the client's W3C ``traceparent``
             # trace id when one arrives well-formed, mint otherwise —

@@ -42,3 +42,11 @@ def test_chaoscheck_end_to_end():
     wd = out["final_health"]["watchdog"]
     # the fused fault is admission-scoped: no extra stalls or restarts
     assert wd["stalls"] == 1 and wd["restarts"] == 2
+    # fleet: one replica of a two-replica fleet killed mid-stream —
+    # the router marked it down within the health-poll bound, the
+    # surviving stream stayed bit-identical, the manager restarted it
+    # within the budget, and its affinity keys came home
+    rk = out["replica_kill"]
+    assert rk["survivor_exact"] and rk["rejoined"]
+    assert rk["restarts"] >= 1
+    assert rk["marked_down_in_s"] < 10

@@ -1,0 +1,719 @@
+"""Fleet control plane: affinity key stability, router fallback,
+autoscaler decision math, manager reconcile/restart/drain, registry
+file, readiness split, and the scheduler-launched replica path.
+
+Everything except the two marked integration tests runs with fake
+launchers/fetchers and an injected clock — no TPU, no engine, no
+sleeping on real health polls."""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from mlcomp_tpu.cache.prefix_key import (
+    normalize_ids,
+    prefix_hash,
+    rendezvous_rank,
+)
+from mlcomp_tpu.fleet.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    FleetSignals,
+)
+from mlcomp_tpu.fleet.manager import (
+    CallableLauncher,
+    ReplicaManager,
+    ReplicaSpec,
+)
+from mlcomp_tpu.fleet.registry import (
+    read_registry,
+    registry_urls,
+    remove_entry,
+    update_entry,
+)
+from mlcomp_tpu.fleet.router import Router
+
+
+# --------------------------------------------------------- prefix key
+
+
+def test_prefix_hash_is_process_stable():
+    # PINNED digest: affinity keys must survive router restarts and
+    # cross-process comparison — a stdlib hash() (seeded per process)
+    # or a changed serialization would break this, and with it every
+    # replica's warm cache
+    assert prefix_hash([1, 2, 3], max_tokens=32) == (
+        "abccad42d03c940bc2b249bf5a4e1e3d"
+    )
+    assert prefix_hash([1, 2, 3]) == prefix_hash((1.0, 2, 3))
+    # only the first max_tokens ids matter: a shared system prompt plus
+    # different user suffixes share a key
+    long_a = list(range(100)) + [7]
+    long_b = list(range(100)) + [8]
+    assert prefix_hash(long_a, 32) == prefix_hash(long_b, 32)
+    assert prefix_hash([1, 2]) != prefix_hash([1, 2, 3])
+
+
+def test_normalize_ids_matches_trie_walk():
+    from mlcomp_tpu.cache.prefix_index import PrefixIndex
+
+    class FakeBlock:
+        def __init__(self, n):
+            self.ntokens = n
+            self.nbytes = n
+
+        def slice(self, a, b):
+            return FakeBlock(b - a)
+
+    idx = PrefixIndex(max_bytes=1 << 20)
+    idx.insert([5, 6, 7, 8], FakeBlock(4))
+    # floats/np-ish inputs coerce exactly like the router's key helper
+    lease = idx.lookup((5.0, 6, 7, 8))
+    assert lease is not None and lease.tokens == 4
+    lease.release()
+    assert normalize_ids((5.0, 6)) == (5, 6)
+
+
+def test_rendezvous_rank_stability_and_minimal_disruption():
+    members = [f"fleet-{i}" for i in range(4)]
+    keys = [prefix_hash([i, i + 1, i + 2]) for i in range(64)]
+    rank_a = {k: rendezvous_rank(k, members) for k in keys}
+    # permutation of the member list changes nothing
+    rank_b = {k: rendezvous_rank(k, members[::-1]) for k in keys}
+    assert rank_a == rank_b
+    # removing one member only re-homes the keys it owned
+    survivors = members[:-1]
+    for k in keys:
+        old = rank_a[k][0]
+        new = rendezvous_rank(k, survivors)[0]
+        if old != members[-1]:
+            assert new == old
+        else:
+            assert new in survivors
+
+
+# ------------------------------------------------------------- router
+
+
+def _mk_router(healthz, **kw):
+    """Router over fake replicas: ``healthz`` maps url -> dict or
+    Exception."""
+    def fetch(url, path, timeout=None, payload=None):
+        v = healthz[url]
+        if isinstance(v, Exception):
+            raise v
+        return v
+
+    clock = kw.pop("clock", None) or (lambda: 0.0)
+    r = Router(urls=list(healthz), fetch=fetch, clock=clock,
+               health_poll_s=0.05, **kw)
+    r.poll_once()
+    return r
+
+
+def _hz(ok=True, ready=True, depth=0):
+    return {"ok": ok, "ready": ready, "queue_depth": depth}
+
+
+def test_router_affinity_stable_across_restarts():
+    urls = [f"http://127.0.0.1:900{i}" for i in range(3)]
+    healthz = {u: _hz() for u in urls}
+    key = prefix_hash([9, 10, 11, 12])
+    picks = set()
+    for _ in range(3):  # three fresh "router restarts"
+        r = _mk_router(healthz)
+        target, reason = r.choose(key)
+        assert reason == "affinity"
+        picks.add(target["name"])
+    assert len(picks) == 1  # same replica every time
+
+
+def test_router_falls_back_when_affinity_target_429s():
+    urls = [f"http://127.0.0.1:901{i}" for i in range(3)]
+    healthz = {u: _hz(depth=2) for u in urls}
+    now = [0.0]
+    r = _mk_router(healthz, clock=lambda: now[0])
+    key = prefix_hash([1, 2, 3, 4])
+    target, reason = r.choose(key)
+    assert reason == "affinity"
+    affinity_name = target["name"]
+    # make one OTHER replica clearly least-loaded
+    light = next(n for n in healthz if n.split("://")[-1] != affinity_name)
+    healthz[light] = _hz(depth=0)
+    r.poll_once()
+    # the affinity target answers 429: the router marks it saturated
+    # and the NEXT same-prefix request goes least-loaded
+    r.mark_saturated(affinity_name)
+    target2, reason2 = r.choose(key)
+    assert reason2 == "least_loaded"
+    assert target2["name"] != affinity_name
+    assert target2["name"] == light.split("://")[-1]
+    # the cooldown expires -> affinity returns home
+    now[0] += r.saturated_cooldown_s + 0.1
+    target3, reason3 = r.choose(key)
+    assert (target3["name"], reason3) == (affinity_name, "affinity")
+
+
+def test_router_routes_around_unhealthy_and_unready():
+    urls = [f"http://127.0.0.1:902{i}" for i in range(2)]
+    healthz = {u: _hz() for u in urls}
+    r = _mk_router(healthz)
+    key = prefix_hash([42, 43, 44])
+    target, _ = r.choose(key)
+    bad = next(u for u in urls if u.endswith(target["name"].split(":")[-1]))
+    # ready: false (draining/warming) diverts traffic without a restart
+    healthz[bad] = _hz(ready=False)
+    r.poll_once()
+    t2, reason = r.choose(key)
+    assert t2["name"] != target["name"] and reason == "least_loaded"
+    # hard-down (connection refused) does too
+    healthz[bad] = ConnectionRefusedError("down")
+    r.poll_once()
+    r.poll_once()
+    t3, _ = r.choose(key)
+    assert t3["name"] != target["name"]
+    # and with EVERY replica down there is nobody to route to
+    for u in urls:
+        healthz[u] = ConnectionRefusedError("down")
+    for _ in range(r.unhealthy_after):
+        r.poll_once()
+    none, reason = r.choose(key)
+    assert none is None and reason == "no_live_replica"
+
+
+def test_router_saturation_by_queue_depth():
+    urls = [f"http://127.0.0.1:903{i}" for i in range(2)]
+    healthz = {u: _hz() for u in urls}
+    r = _mk_router(healthz, saturation_queue_depth=4)
+    key = prefix_hash([7, 8, 9])
+    target, _ = r.choose(key)
+    deep = next(u for u in urls if u.endswith(target["name"].split(":")[-1]))
+    healthz[deep] = _hz(depth=10)  # past the saturation bound
+    r.poll_once()
+    t2, reason = r.choose(key)
+    assert t2["name"] != target["name"] and reason == "least_loaded"
+
+
+# ---------------------------------------------------------- autoscaler
+
+
+def _scaler(policy=None, **kw):
+    now = [0.0]
+    sc = Autoscaler(
+        policy or AutoscalePolicy(
+            min_replicas=1, max_replicas=4, sustain_s=30.0,
+            idle_s=300.0, cooldown_s=60.0,
+        ),
+        clock=lambda: now[0], **kw,
+    )
+    return sc, now
+
+
+BURN = FleetSignals(slo_breached=True, requests_delta=10,
+                    live_replicas=2)
+REJECTS = FleetSignals(reject_ratio=0.5, requests_delta=10,
+                       live_replicas=2)
+BUSY = FleetSignals(requests_delta=10, live_replicas=2)
+IDLE = FleetSignals(requests_delta=0, live_replicas=2)
+
+
+def test_autoscaler_table_driven_decisions():
+    # (advance_s, signals, expected_direction) — hysteresis pinned
+    table = [
+        (0, BURN, "hold"),      # breach starts; unsustained
+        (10, BURN, "hold"),     # 10s < sustain_s
+        (25, BURN, "up"),       # 35s sustained -> scale up
+        (10, BURN, "hold"),     # cooldown blocks a second action
+        (55, BURN, "up"),       # cooldown over, still burning
+        (10, BUSY, "hold"),     # recovered: traffic, no burn
+        (100, IDLE, "hold"),    # idle clock starts
+        (250, IDLE, "hold"),    # 250s < idle_s
+        (100, IDLE, "down"),    # 350s idle -> scale down
+        (30, IDLE, "hold"),     # cooldown again
+    ]
+    sc, now = _scaler()
+    results = []
+    for dt, sig, want in table:
+        now[0] += dt
+        d = sc.observe(sig)
+        results.append((want, d["direction"], d["reason"]))
+    for want, got, reason in results:
+        assert want == got, results
+    st = sc.stats()
+    assert st["actions"] == {"up": 2, "down": 1}
+
+
+def test_autoscaler_reject_ratio_and_bounds():
+    sc, now = _scaler(policy=AutoscalePolicy(
+        min_replicas=1, max_replicas=3, sustain_s=0.0, cooldown_s=0.0,
+    ))
+    d = sc.observe(REJECTS)
+    assert d["direction"] == "up" and d["reason"] == "reject_ratio"
+    assert d["target"] == 3
+    # at the ceiling the decision reports why it held
+    at_max = FleetSignals(reject_ratio=0.5, requests_delta=5,
+                          live_replicas=3)
+    d2 = sc.observe(at_max)
+    assert d2["direction"] == "hold" and d2["reason"].endswith("_at_max")
+    # and the floor guards the other side
+    sc2, now2 = _scaler(policy=AutoscalePolicy(
+        min_replicas=1, max_replicas=4, idle_s=0.0, cooldown_s=0.0,
+    ))
+    d3 = sc2.observe(FleetSignals(live_replicas=1))
+    assert d3["direction"] == "hold" and d3["reason"].endswith("_at_min")
+
+
+def test_autoscaler_dry_run_logs_but_does_not_apply():
+    calls = []
+    mgr = SimpleNamespace(
+        target=2, set_target=lambda n: calls.append(n), urls=lambda: [],
+    )
+    sc, now = _scaler(policy=AutoscalePolicy(
+        min_replicas=1, max_replicas=4, sustain_s=0.0, cooldown_s=0.0,
+    ), manager=mgr, dry_run=True)
+    d = sc.observe(BURN)
+    assert d["direction"] == "up" and d["dry_run"] and not d["applied"]
+    assert calls == []  # decision logged, lever untouched
+    assert sc.decisions[-1]["reason"] == "slo_burn"
+    # live mode applies through the manager
+    sc2, _ = _scaler(policy=AutoscalePolicy(
+        min_replicas=1, max_replicas=4, sustain_s=0.0, cooldown_s=0.0,
+    ), manager=mgr, dry_run=False)
+    d2 = sc2.observe(BURN)
+    assert d2["applied"] and calls == [3]
+
+
+def test_autoscaler_scrape_builds_signals_from_healthz():
+    payloads = {
+        "http://a": {
+            "ok": True, "requests": 100, "rejected": {"queue_full": 10},
+            "slo": {"breached": [], "burn_rate": {
+                "ttft_p95": {"fast": 2.0, "slow": 1.5},
+            }},
+        },
+        "http://b": ConnectionRefusedError("down"),
+    }
+
+    def fetch(url, path, timeout=None, payload=None):
+        v = payloads[url]
+        if isinstance(v, Exception):
+            raise v
+        return v
+
+    sc = Autoscaler(AutoscalePolicy(), fetch=fetch)
+    s1 = sc.scrape(["http://a", "http://b"])
+    # both windows burn above threshold -> overload even without the
+    # SLO engine's own breached list
+    assert s1.slo_breached and s1.live_replicas == 1
+    assert s1.detail["http://b"] == "unreachable"
+    # second scrape differences the counters
+    payloads["http://a"]["requests"] = 140
+    payloads["http://a"]["rejected"] = {"queue_full": 30}
+    s2 = sc.scrape(["http://a", "http://b"])
+    assert s2.requests_delta == 40
+    assert s2.reject_ratio == pytest.approx(20 / 60)
+
+
+# ----------------------------------------------------------- registry
+
+
+def test_registry_file_merge_and_urls(tmp_path):
+    path = str(tmp_path / "reg.json")
+    assert read_registry(path) == {}
+    update_entry(path, "fleet-0", url="http://h:1", state="starting")
+    # a writer that doesn't know the url must not erase it
+    update_entry(path, "fleet-0", url=None, state="live")
+    update_entry(path, "fleet-1", url="http://h:2", state="live")
+    data = read_registry(path)
+    assert data["fleet-0"]["url"] == "http://h:1"
+    assert data["fleet-0"]["state"] == "live"
+    assert registry_urls(path) == ["http://h:1", "http://h:2"]
+    assert registry_urls(path, states=["live"]) == [
+        "http://h:1", "http://h:2",
+    ]
+    remove_entry(path, "fleet-0")
+    assert registry_urls(path) == ["http://h:2"]
+    # garbled file reads as empty, never raises
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert read_registry(path) == {}
+
+
+def test_report_server_fleet_urls_prefer_registry(tmp_path,
+                                                  monkeypatch):
+    from mlcomp_tpu.report.server import _fleet_urls
+
+    path = str(tmp_path / "reg.json")
+    update_entry(path, "r0", url="http://dyn:1", state="live")
+    monkeypatch.setenv("MLCOMP_TPU_SERVE_REGISTRY", path)
+    monkeypatch.setenv("MLCOMP_TPU_SERVE_URLS", "http://static:9")
+    assert _fleet_urls() == ["http://dyn:1"]
+    # an empty registry falls back to the static env wiring
+    remove_entry(path, "r0")
+    assert _fleet_urls() == ["http://static:9"]
+
+
+# ------------------------------------------------------------ manager
+
+
+class _FakeFleet:
+    """A launcher + fetch pair simulating replicas without HTTP."""
+
+    def __init__(self):
+        self.spawned = []
+        self.stopped = []
+        self.health = {}   # name -> healthz dict or Exception
+
+    def launcher(self):
+        def spawn(name, port):
+            self.spawned.append(name)
+            self.health.setdefault(
+                name, {"ok": True, "ready": True, "queue_depth": 0}
+            )
+            return SimpleNamespace(
+                url=f"http://fake/{name}",
+                stop=lambda n=name: self.stopped.append(n),
+            )
+
+        return CallableLauncher(spawn)
+
+    def fetch(self, url, path, timeout=None, payload=None):
+        name = url.rsplit("/", 1)[-1]
+        if path == "/drain":
+            self.health[name]["ready"] = False
+            return {"ok": True, "draining": True}
+        v = self.health[name]
+        if isinstance(v, Exception):
+            raise v
+        return v
+
+
+def _mk_manager(fleet, tmp_path, now, **spec_kw):
+    spec_kw.setdefault("target", 2)
+    spec_kw.setdefault("unhealthy_after", 2)
+    spec_kw.setdefault("restart_budget", 2)
+    spec_kw.setdefault("healthy_reset_s", 50.0)
+    # no startup grace: these tables drive the fake clock by hand and
+    # the fake replicas are "bound" the instant they spawn
+    spec_kw.setdefault("startup_grace_s", 0.0)
+    return ReplicaManager(
+        fleet.launcher(), ReplicaSpec(**spec_kw),
+        registry_path=str(tmp_path / "reg.json"),
+        clock=lambda: now[0], fetch=fleet.fetch,
+    )
+
+
+def test_manager_reconciles_to_target_and_registers(tmp_path):
+    fleet = _FakeFleet()
+    now = [0.0]
+    mgr = _mk_manager(fleet, tmp_path, now)
+    mgr.tick()
+    assert fleet.spawned == ["fleet-0", "fleet-1"]
+    st = mgr.stats()
+    assert st["live"] == 2 and st["target"] == 2
+    reg = read_registry(str(tmp_path / "reg.json"))
+    assert sorted(reg) == ["fleet-0", "fleet-1"]
+    assert all(e["state"] == "live" for e in reg.values())
+    # scale up through the autoscaler's lever
+    mgr.set_target(3)
+    mgr.tick()
+    assert fleet.spawned[-1] == "fleet-2"
+    assert mgr.stats()["live"] == 3
+
+
+def test_manager_restarts_unhealthy_with_bounded_budget(tmp_path):
+    fleet = _FakeFleet()
+    now = [0.0]
+    mgr = _mk_manager(fleet, tmp_path, now, target=1)
+    mgr.tick()
+    assert fleet.spawned == ["fleet-0"]
+    # watchdog 503s: ok false but answering — same restart path
+    fleet.health["fleet-0"] = {"ok": False, "ready": False,
+                               "queue_depth": 0}
+
+    def fail_polls(n):
+        for _ in range(n):
+            now[0] += 1.0
+            mgr.tick()
+
+    fail_polls(2)  # unhealthy_after=2 -> restart #1
+    assert fleet.spawned.count("fleet-0") == 2
+    assert fleet.stopped.count("fleet-0") == 1
+    fail_polls(2)  # restart #2 — budget exhausted after this
+    assert fleet.spawned.count("fleet-0") == 3
+    fail_polls(4)  # budget spent: no more spawns, state=failed
+    assert fleet.spawned.count("fleet-0") == 3
+    st = mgr.stats()
+    assert st["states"].get("failed") == 1
+    assert st["restarts"]["unhealthy"] == 2
+    assert st["restarts"]["budget_exhausted"] == 1
+    # a budget-exhausted replica HOLDS its slot: no replacement
+    # cascade spawning fleet-1, fleet-2, ... through fresh budgets
+    assert fleet.spawned == ["fleet-0"] * 3
+
+
+def test_manager_startup_grace_tolerates_slow_boot(tmp_path):
+    """The bug the end-to-end CLI drive caught: a real serve child
+    takes tens of seconds to load weights before it binds, and without
+    startup grace the manager kill-looped every booting replica
+    through its whole restart budget, then cascaded replacements until
+    the port range exhausted."""
+    fleet = _FakeFleet()
+    now = [0.0]
+    # pre-set: the replica will NOT answer its health port yet
+    fleet.health["fleet-0"] = ConnectionRefusedError("still booting")
+    mgr = _mk_manager(fleet, tmp_path, now, target=1,
+                      startup_grace_s=30.0)
+    for _ in range(10):
+        now[0] += 1.0
+        mgr.tick()
+    # ten silent polls inside the grace: no restart, no kill-loop
+    assert fleet.spawned == ["fleet-0"]
+    assert fleet.stopped == []
+    # grace expires with still no answer -> the normal restart
+    # machinery engages
+    now[0] = 40.0
+    mgr.tick()
+    now[0] += 1.0
+    mgr.tick()
+    assert fleet.spawned.count("fleet-0") == 2
+    # ... and the fresh incarnation finally boots healthy
+    fleet.health["fleet-0"] = {"ok": True, "ready": True,
+                               "queue_depth": 0}
+    now[0] += 1.0
+    mgr.tick()
+    assert mgr.stats()["live"] == 1
+    # a replica that HAS been healthy gets no grace on its next death
+    fleet.health["fleet-0"] = ConnectionRefusedError("crashed")
+    now[0] += 1.0
+    mgr.tick()
+    now[0] += 1.0
+    mgr.tick()
+    assert fleet.spawned.count("fleet-0") == 3  # detected at the bound
+
+
+def test_manager_progress_gate_refills_restart_budget(tmp_path):
+    fleet = _FakeFleet()
+    now = [0.0]
+    mgr = _mk_manager(fleet, tmp_path, now, target=1)
+    mgr.tick()
+    fleet.health["fleet-0"] = ConnectionRefusedError("down")
+    for _ in range(2):
+        now[0] += 1.0
+        mgr.tick()
+    assert fleet.spawned.count("fleet-0") == 2  # one restart spent
+    # the restarted replica HOLDS healthy past healthy_reset_s
+    fleet.health["fleet-0"] = {"ok": True, "ready": True,
+                               "queue_depth": 0}
+    now[0] += 60.0
+    mgr.tick()
+    with mgr._lock:
+        assert mgr._replicas["fleet-0"].restarts == 0  # refilled
+
+
+def test_manager_drains_before_scale_down(tmp_path):
+    fleet = _FakeFleet()
+    now = [0.0]
+    mgr = _mk_manager(fleet, tmp_path, now, target=2,
+                      drain_timeout_s=100.0)
+    mgr.tick()
+    fleet.health["fleet-1"]["queue_depth"] = 3  # in-flight work
+    now[0] += 1.0
+    mgr.tick()
+    mgr.set_target(1)
+    now[0] += 1.0
+    mgr.tick()
+    # drained, not killed: the replica got POST /drain (ready False)
+    # and is still running while its queue empties
+    assert fleet.health["fleet-1"]["ready"] is False
+    assert "fleet-1" not in fleet.stopped
+    reg = read_registry(str(tmp_path / "reg.json"))
+    assert reg["fleet-1"]["state"] == "draining"
+    # the queue empties but a stream is still DECODING in a slot
+    # (queue_depth never counts active slots): the stop must wait
+    fleet.health["fleet-1"]["queue_depth"] = 0
+    fleet.health["fleet-1"]["engine"] = {"active_slots": 1}
+    now[0] += 1.0
+    mgr.tick()
+    now[0] += 1.0
+    mgr.tick()
+    assert "fleet-1" not in fleet.stopped
+    # the stream finishes -> the stop lands and the registry entry goes
+    fleet.health["fleet-1"]["engine"] = {"active_slots": 0}
+    now[0] += 1.0
+    mgr.tick()
+    now[0] += 1.0
+    mgr.tick()
+    assert "fleet-1" in fleet.stopped
+    assert "fleet-1" not in read_registry(str(tmp_path / "reg.json"))
+    # and no replacement was spawned for it
+    assert fleet.spawned == ["fleet-0", "fleet-1"]
+
+
+def test_manager_metrics_families(tmp_path):
+    from mlcomp_tpu.obs.metrics import Registry
+
+    fleet = _FakeFleet()
+    now = [0.0]
+    reg = Registry()
+    mgr = ReplicaManager(
+        fleet.launcher(), ReplicaSpec(target=1),
+        metrics=reg, registry_path=str(tmp_path / "reg.json"),
+        clock=lambda: now[0], fetch=fleet.fetch,
+    )
+    mgr.tick()
+    text = reg.render()
+    assert "mlcomp_fleet_replicas_target 1" in text
+    assert "mlcomp_fleet_replicas_live 1" in text
+    assert 'mlcomp_fleet_replica_restarts_total{reason="unhealthy"} 0' \
+        in text
+
+
+# ---------------------------------------------- serve readiness + drain
+
+
+@pytest.fixture(scope="module")
+def toy_service():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.serve import GenerationService
+    from mlcomp_tpu.train.state import init_model
+
+    model = create_model({
+        "name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+        "layers": 1, "heads": 2, "mlp_dim": 64, "dtype": "float32",
+    })
+    prompt = jnp.asarray(np.random.RandomState(0).randint(1, 64, (1, 8)))
+    params, _ = init_model(model, {"x": prompt}, jax.random.PRNGKey(0))
+    svc = GenerationService(
+        model, {"params": params}, batch_sizes=(1,),
+        prompt_buckets=(16,), max_new_buckets=(8,),
+        metrics_history_interval=0,
+    )
+    yield svc
+    svc.close()
+
+
+def test_ready_splits_from_ok(toy_service):
+    st = toy_service.stats()
+    assert st["healthy"] and st["ready"] and not st["draining"]
+    toy_service.set_draining(True)
+    st = toy_service.stats()
+    # draining: NOT ready (router diverts) but still ok (manager must
+    # not restart a deliberately draining daemon)
+    assert st["healthy"] and not st["ready"] and st["draining"]
+    toy_service.set_draining(False)
+    assert toy_service.stats()["ready"]
+
+
+def test_drain_route_flips_readiness(toy_service):
+    import urllib.request
+
+    from mlcomp_tpu.serve import make_http_server
+
+    httpd = make_http_server(toy_service, "127.0.0.1", 0, "toy")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def post_drain(draining):
+        req = urllib.request.Request(
+            f"{base}/drain",
+            data=json.dumps({"draining": draining}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        assert post_drain(True) == {"ok": True, "draining": True}
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            hz = json.loads(r.read())
+            assert r.status == 200  # draining is NOT unhealthy
+        assert hz["ok"] and not hz["ready"] and hz["draining"]
+        assert post_drain(False)["draining"] is False
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert json.loads(r.read())["ready"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        toy_service.set_draining(False)
+
+
+# ------------------------------------------- scheduler-launched replica
+
+
+def test_scheduler_launcher_runs_replica_as_task(tmp_path):
+    """The tentpole's scheduler leg: a replica submitted as a
+    single-task DAG, claimed by a Worker, serving until stopped —
+    URL published to and removed from the registry by the executor."""
+    import urllib.request
+
+    from mlcomp_tpu.db.store import Store
+    from mlcomp_tpu.fleet.manager import SchedulerLauncher
+    from mlcomp_tpu.scheduler.supervisor import Supervisor
+    from mlcomp_tpu.scheduler.worker import Worker
+
+    db = str(tmp_path / "store.sqlite")
+    registry_path = str(tmp_path / "reg.json")
+    store = Store(db)
+    launcher = SchedulerLauncher(
+        store,
+        model_cfg={
+            "name": "transformer_lm", "vocab_size": 64, "hidden": 32,
+            "layers": 1, "heads": 2, "mlp_dim": 64,
+            "dtype": "float32",
+        },
+        registry_path=registry_path,
+        serve_args={
+            "batch_sizes": [1], "prompt_buckets": [16],
+            "max_new_buckets": [8], "metrics_history_interval": 0,
+            "stop_poll_s": 0.2,
+        },
+    )
+    handle = launcher.spawn("fleet-0", 0)
+    assert handle.url is None  # not published yet
+    Supervisor(store).tick()  # queue the replica task
+
+    def run_worker():
+        # the Worker's Store must be created on the thread that uses
+        # it (sqlite connections are per-thread)
+        w = Worker(
+            Store(db), name="w0", workdir=str(tmp_path / "w"),
+            isolate=False,
+        )
+        w.run_once()
+
+    t = threading.Thread(target=run_worker, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 120
+        url = None
+        while time.time() < deadline:
+            url = handle.url
+            if url:
+                break
+            time.sleep(0.1)
+        assert url, "replica never published its URL"
+        assert read_registry(registry_path)["fleet-0"]["url"] == url
+        with urllib.request.urlopen(f"{url}/healthz", timeout=30) as r:
+            hz = json.loads(r.read())
+        assert hz["ok"] and hz["model"] == "transformer_lm"
+        # the manager's stop: flip the task row; the executor's
+        # ownership poll tears the daemon down and deregisters
+        handle.stop()
+        t.join(timeout=60)
+        assert not t.is_alive(), "worker did not release the replica"
+        assert "fleet-0" not in read_registry(registry_path)
+    finally:
+        if t.is_alive():
+            store.stop_dag(handle.dag_id)
+            t.join(timeout=60)
+        store.close()

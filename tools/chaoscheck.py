@@ -79,6 +79,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from mlcomp_tpu.utils import faults  # noqa: E402
 
 
+# share the engines' compiled programs across same-config daemons (the
+# tests/test_serve.py _CONT_FNS idiom): the replica-kill fleet scenario
+# builds three more default-config daemons, and each would otherwise
+# re-pay the full prefill/insert/dispatch compile bill — the dominant
+# line in this harness's wall time.  Only the exact default svc_kw
+# shares; scenario 6's tight pool (different page-table shapes) opts
+# out by construction.
+_SHARED_FNS: dict = {}
+_SHARED_KW = {"kv_layout": "paged", "max_slots": 4, "kv_pages": 34}
+
+
 class _Daemon:
     """The toy serving daemon + typed HTTP helpers."""
 
@@ -119,6 +130,11 @@ class _Daemon:
             dispatch_stall_timeout=60.0,
             **svc_kw,
         )
+        self._pool_fns = svc_kw == _SHARED_KW and (
+            self.svc.engine is not None
+        )
+        if self._pool_fns:
+            self.svc.engine._fns.update(_SHARED_FNS)
         self.httpd = make_http_server(self.svc, "127.0.0.1", 0, "chaos")
         threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
         self.base = f"http://127.0.0.1:{self.httpd.server_address[1]}"
@@ -209,7 +225,15 @@ class _Daemon:
         assert cs["capture_queue_depth"] == 0, (what, cs)
         self.svc.prefix_cache.index.check_invariants()
 
+    def harvest_fns(self):
+        """Bank this daemon's compiled programs for the next
+        same-config daemon (restart-heavy scenarios would otherwise
+        recompile per incarnation)."""
+        if self._pool_fns:
+            _SHARED_FNS.update(self.svc.engine._fns)
+
     def close(self):
+        self.harvest_fns()
         self.httpd.shutdown()
         self.httpd.server_close()
         self.svc.close()
@@ -387,6 +411,7 @@ def run() -> dict:
         }
         out["page_pool_exhaustion"] = _scenario_page_exhaustion()
         out["lazy_page_exhaustion"] = _scenario_lazy_page_exhaustion()
+        out["replica_kill"] = _scenario_replica_kill()
         return out
     finally:
         faults.disarm_all()
@@ -576,6 +601,261 @@ def _scenario_lazy_page_exhaustion() -> dict:
         }
     finally:
         eng.close()
+
+
+def _scenario_replica_kill() -> dict:
+    """Scenario 8 — kill one replica of a two-replica fleet mid-stream
+    (mlcomp_tpu/fleet: ReplicaManager + prefix-affinity Router, real
+    HTTP end to end).  Contract under test:
+
+    - the router stops sending the dead replica traffic within the
+      health-poll bound (the first failed proxy marks it down
+      immediately; the poll loop confirms);
+    - the client-visible damage is BOUNDED: the victim's own in-flight
+      stream terminates with an SSE error event — every other request,
+      including the re-routed affinity traffic, succeeds with tokens
+      bit-identical to baseline (replicas share deterministic toy
+      weights, so cross-replica equality is meaningful);
+    - the survivor's concurrent stream is bit-identical to its solo
+      run;
+    - the manager restarts the dead replica within its budget, the
+      router re-admits it, and its affinity keys COME HOME (rendezvous
+      hashing keys on the stable replica name, not the port), with the
+      repeated prefix warming its fresh cache.
+    """
+    from types import SimpleNamespace
+
+    from mlcomp_tpu.fleet import (
+        CallableLauncher,
+        ReplicaManager,
+        ReplicaSpec,
+        Router,
+        make_router_http_server,
+    )
+
+    daemons: dict = {}
+    spawns: list = []
+
+    def close_daemon(d: "_Daemon") -> None:
+        for step in (d.harvest_fns, d.httpd.shutdown,
+                     d.httpd.server_close, d.svc.close):
+            try:
+                step()
+            except Exception:
+                pass
+
+    def spawn(name, port):
+        dmn = _Daemon()
+        daemons[name] = dmn
+        spawns.append(name)
+        return SimpleNamespace(
+            url=dmn.base, stop=lambda dmn=dmn: close_daemon(dmn)
+        )
+
+    mgr = ReplicaManager(
+        CallableLauncher(spawn),
+        ReplicaSpec(target=2, health_poll_s=0.25,
+                    health_timeout_s=1.0, unhealthy_after=2,
+                    restart_budget=3),
+    )
+    router = Router(manager=mgr, health_poll_s=0.2,
+                    health_timeout_s=1.0, unhealthy_after=2,
+                    saturated_cooldown_s=1.0)
+    rhttpd = None
+    try:
+        mgr.start()
+        router.start()
+        rhttpd = make_router_http_server(router, "127.0.0.1", 0)
+        threading.Thread(
+            target=rhttpd.serve_forever, daemon=True
+        ).start()
+        rbase = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+        def wait_live(n, deadline_s=180.0):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < deadline_s:
+                if router.status()["live"] >= n:
+                    return
+                time.sleep(0.1)
+            raise AssertionError(
+                f"fleet never reached {n} live replicas: "
+                f"{router.status()}"
+            )
+
+        def generate(ids, n_new=4):
+            body = json.dumps(
+                {"prompt": list(ids), "max_new_tokens": n_new}
+            ).encode()
+            req = urllib.request.Request(
+                f"{rbase}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return r.status, json.loads(r.read()), (
+                        r.headers.get("x-mlcomp-replica")
+                    )
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read()), (
+                    e.headers.get("x-mlcomp-replica")
+                )
+
+        def open_stream(ids, n_new=8):
+            body = {"prompt": list(ids), "max_new_tokens": n_new,
+                    "stream": True}
+            req = urllib.request.Request(
+                f"{rbase}/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return urllib.request.urlopen(req, timeout=120)
+
+        wait_live(2)
+        # find one prompt per replica (different prompts hash to
+        # different affinity keys; with two replicas a handful of
+        # probes covers both)
+        base_prompt = [9, 10, 11, 12, 13, 14, 15, 16, 17]
+        by_replica: dict = {}
+        baselines: dict = {}
+        for i in range(3, 40):
+            p = base_prompt + [i]
+            code, payload, replica = generate(p)
+            assert code == 200, (code, payload)
+            if replica not in by_replica:
+                by_replica[replica] = p
+                baselines[replica] = payload["ids"]
+            if len(by_replica) == 2:
+                break
+        assert len(by_replica) == 2, (
+            f"affinity never spread over both replicas: {by_replica}"
+        )
+        names = sorted(by_replica)
+        victim_name, survivor_name = names[0], names[1]
+        p_victim = by_replica[victim_name]
+        p_survivor = by_replica[survivor_name]
+        # affinity is sticky: the same prompt lands on the same replica
+        for name, p in by_replica.items():
+            code, payload, replica = generate(p)
+            assert (code, replica) == (200, name), (code, replica)
+            assert payload["ids"] == baselines[name], payload
+        # solo survivor stream baseline (streamed tokens, full budget)
+        toks_solo, _ = _Daemon.read_stream(open_stream(p_survivor, 8))
+        daemons[survivor_name].svc.prefix_cache.flush()
+
+        # open both streams, then KILL the victim replica with its own
+        # stream in flight.  The toy decode finishes 8 tokens in tens
+        # of ms — far inside the kill window — so a bounded resolve
+        # sleep (scenario 0 proved it latency-only) holds both streams
+        # open long enough for the kill to land mid-stream.
+        faults.arm("engine.resolve", flavor="sleep", times=8,
+                   seconds=0.3)
+        surv_resp = open_stream(p_survivor, 8)
+        vict_resp = open_stream(p_victim, 8)
+        t_kill = time.perf_counter()
+        close_daemon(daemons[victim_name])
+        # victim stream: BOUNDED failure — an SSE error event, a torn
+        # connection, or (if the toy decode won the race) a clean
+        # finish; never a hang.  That one stream is the whole
+        # client-visible cost of losing the replica.
+        victim_outcome = "eof"
+        try:
+            for raw in vict_resp:
+                line = raw.decode().strip()
+                if line.startswith("data: "):
+                    item = json.loads(line[len("data: "):])
+                    if "error" in item:
+                        victim_outcome = "error_event"
+                        break
+                    if item.get("done"):
+                        victim_outcome = "completed_before_kill"
+                        break
+        except (OSError, ValueError):
+            victim_outcome = "connection_torn"
+        vict_resp.close()
+        victim_fail_s = time.perf_counter() - t_kill
+        assert victim_fail_s < 30, (
+            f"victim stream lingered {victim_fail_s:.1f}s"
+        )
+        # the survivor's concurrent stream is bit-identical to solo
+        surv_toks, _ = _Daemon.read_stream(surv_resp)
+        faults.disarm_all()
+        assert surv_toks == toks_solo, (surv_toks, toks_solo)
+        # the router stops routing to the DEAD replica within the
+        # health-poll bound: either it observably marks it down, or the
+        # manager's restart already replaced the URL (shared compiled
+        # programs make a toy respawn ~1 s, so the down window can
+        # close before a poll lands) — in both cases no request is
+        # routed at the dead socket past the bound, and a request that
+        # does hit it conn-refuses into an immediate markdown + retry
+        victim_url = {
+            r["name"]: r["url"] for r in router.status()["replicas"]
+        }.get(victim_name)
+        bound_s = (
+            router.unhealthy_after * router.health_poll_s
+            + router.health_timeout_s + 2.0
+        )
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < bound_s:
+            reps = {
+                r["name"]: r for r in router.status()["replicas"]
+            }
+            if victim_name not in reps:
+                break  # manager cycled it out for restart
+            if not reps[victim_name]["live"]:
+                break  # observed down
+            if reps[victim_name]["url"] != victim_url:
+                break  # already restarted on a fresh port
+            if spawns.count(victim_name) >= 2:
+                break  # restart in flight
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"router still considers {victim_name} live at its "
+                f"dead url {bound_s:.1f}s after the kill: "
+                f"{router.status()}"
+            )
+        marked_down_s = time.perf_counter() - t_kill
+        # re-routed affinity traffic succeeds NOW, with exact tokens
+        # (the fallback replica shares the deterministic weights)
+        code, payload, replica = generate(p_victim)
+        assert code == 200, (code, payload)
+        assert payload["ids"] == baselines[victim_name], payload
+        # the manager restarts it and it REJOINS rotation: same name,
+        # fresh port, affinity keys come home
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 240:
+            if spawns.count(victim_name) >= 2 and (
+                router.status()["live"] >= 2
+            ):
+                break
+            time.sleep(0.2)
+        assert spawns.count(victim_name) >= 2, (
+            f"manager never restarted {victim_name}: {mgr.stats()}"
+        )
+        wait_live(2)
+        code, payload, replica = generate(p_victim)
+        assert (code, replica) == (200, victim_name), (code, replica)
+        assert payload["ids"] == baselines[victim_name], payload
+        # repeated prefix warms the rejoined replica's fresh cache
+        daemons[victim_name].svc.prefix_cache.flush()
+        code, payload, replica = generate(p_victim)
+        assert (code, replica) == (200, victim_name), (code, replica)
+        assert payload.get("cache_hit_tokens", 0) > 0, payload
+        st = router.status()
+        assert st["counts"]["reason"]["affinity"] > 0, st["counts"]
+        return {
+            "victim_outcome": victim_outcome,
+            "victim_failed_in_s": round(victim_fail_s, 2),
+            "marked_down_in_s": round(marked_down_s, 2),
+            "survivor_exact": True,
+            "restarts": mgr.stats()["restarts"]["unhealthy"],
+            "rejoined": True,
+        }
+    finally:
+        if rhttpd is not None:
+            rhttpd.shutdown()
+            rhttpd.server_close()
+        router.close()
+        mgr.close(stop_replicas=True)
 
 
 def main(argv=None) -> int:

@@ -1055,8 +1055,10 @@ def parse_env_table(serving_md: str) -> Set[str]:
     return out
 
 
-def parse_metric_docs(obs_md: str) -> Set[str]:
-    sec = parse_md_section(obs_md, "## Metrics catalog — serve daemon")
+def parse_metric_docs(obs_md: str,
+                      heading: str = "## Metrics catalog — serve daemon"
+                      ) -> Set[str]:
+    sec = parse_md_section(obs_md, heading)
     out: Set[str] = set()
     for line in sec.splitlines():
         if not line.startswith("|"):
@@ -1116,10 +1118,12 @@ def _glob_match(pattern: str, name: str) -> bool:
     ) is not None
 
 
-def parse_obs_check_list(mi: ModuleInfo) -> Tuple[Set[str], int]:
+def parse_obs_check_list(mi: ModuleInfo,
+                         list_name: str = "DOCUMENTED_SERVE_METRICS"
+                         ) -> Tuple[Set[str], int]:
     for node in ast.walk(mi.tree):
         if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "DOCUMENTED_SERVE_METRICS"
+            isinstance(t, ast.Name) and t.id == list_name
             for t in node.targets
         ) and isinstance(node.value, (ast.List, ast.Tuple)):
             names = {
@@ -1298,6 +1302,50 @@ def check_drift(root: str,
             "DOCUMENTED_SERVE_METRICS enforcement list (conditional "
             "families belong in graftcheck's CONDITIONAL_METRICS with "
             "a justification)",
+        ))
+
+    # ---- fleet control-plane metrics: the same three-way sync for
+    # mlcomp_tpu/fleet/ collectors vs the fleet docs catalog vs
+    # obs_check's DOCUMENTED_FLEET_METRICS list (the fleet surfaces
+    # scrape from the ROUTER's /metrics, not the serve daemon's, so
+    # they get their own catalog section and enforcement list)
+    fleet_mods = {
+        rel: mi for rel, mi in code.items()
+        if rel.startswith("mlcomp_tpu/fleet/")
+    }
+    fleet_code = collect_code_metrics(fleet_mods)
+    fleet_docs = parse_metric_docs(
+        obs_md, heading="## Metrics catalog — fleet control plane"
+    )
+    fleet_enforced, fleet_line = (
+        parse_obs_check_list(obs_mi, "DOCUMENTED_FLEET_METRICS")
+        if obs_mi else (set(), 0)
+    )
+    for name, (rel, line) in sorted(fleet_code.items()):
+        if name not in fleet_docs:
+            findings.append(Finding(
+                "metric-drift", rel, line,
+                f"fleet metric {name} registered here is missing from "
+                "docs/observability.md's fleet control-plane catalog",
+            ))
+    for name in sorted(fleet_docs - set(fleet_code)):
+        findings.append(Finding(
+            "metric-drift", "docs/observability.md", 1,
+            f"documented fleet metric {name} is registered by no "
+            "collector in mlcomp_tpu/fleet/ — stale row",
+        ))
+    for name in sorted(fleet_enforced - fleet_docs):
+        findings.append(Finding(
+            "metric-drift", "tools/obs_check.py", fleet_line,
+            f"obs_check enforces fleet metric {name} but "
+            "docs/observability.md's fleet catalog does not document "
+            "it",
+        ))
+    for name in sorted(fleet_docs - fleet_enforced):
+        findings.append(Finding(
+            "metric-drift", "tools/obs_check.py", fleet_line or 1,
+            f"documented fleet metric {name} is missing from "
+            "obs_check's DOCUMENTED_FLEET_METRICS enforcement list",
         ))
 
     # ---- fault points vs the chaos/test surface that drives them

@@ -31,10 +31,19 @@ observability contract the docs promise (docs/observability.md):
   W3C ``traceparent`` echoes its trace id and
   ``GET /trace?trace_id=`` / ``?rid=`` return exactly that request's
   events;
-- the FLEET: a second toy daemon plus a report server scraping both
-  (``MLCOMP_TPU_SERVE_URLS``) serve ONE merged ``/fleet/trace`` with
-  one pid per daemon (named, clock-aligned) and one ``/fleet/metrics``
-  exposition with a ``daemon`` label per sample.
+- the FLEET: a second toy daemon, adopted with the first into a
+  two-replica set by the fleet ReplicaManager (mlcomp_tpu/fleet) and
+  fronted by the prefix-affinity Router; a report server scraping the
+  manager's DYNAMIC registry (``MLCOMP_TPU_SERVE_REGISTRY``) serves
+  ONE merged ``/fleet/trace`` with one pid per daemon (named,
+  clock-aligned) and one ``/fleet/metrics`` exposition with a
+  ``daemon`` label per sample.  End to end through the router: a
+  traced request's spans land under the replica that served it,
+  a repeated prefix re-lands on its affinity replica and HITS its
+  warmed cache (cache-hit-token counters prove it), every documented
+  ``mlcomp_fleet_*`` family scrapes clean from the router's
+  ``/metrics``, and the autoscaler's decision log responds to an
+  injected burn-rate breach without moving the dry-run target.
 
 No TPU needed (CPU jax), finishes in seconds; tests/test_obs_check.py
 wires it into tier-1 like tools/cachecheck.py.  Standalone:
@@ -128,6 +137,21 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_slo_burn_rate",
     "mlcomp_slo_breached",
     "mlcomp_slo_breaches_total",
+]
+
+# the fleet control-plane families docs/observability.md documents
+# (rendered by the ROUTER's /metrics — manager, router, and autoscaler
+# share one registry); graftcheck's drift pass keeps this list, the
+# docs catalog, and the mlcomp_tpu/fleet/ collectors in three-way sync
+DOCUMENTED_FLEET_METRICS = [
+    "mlcomp_fleet_replicas_target",
+    "mlcomp_fleet_replicas_live",
+    "mlcomp_fleet_replica_restarts_total",
+    "mlcomp_fleet_router_requests_total",
+    "mlcomp_fleet_router_routed_total",
+    "mlcomp_fleet_router_upstream_retries_total",
+    "mlcomp_fleet_router_replicas_live",
+    "mlcomp_fleet_autoscale_decisions_total",
 ]
 
 _SAMPLE_RE = re.compile(
@@ -484,16 +508,29 @@ def run(n_requests: int = 3) -> dict:
         by_rid = json.loads(get(f"/trace?rid={rid}"))
         assert len(by_rid["traceEvents"]) == len(filt["traceEvents"])
 
-        # ---- the fleet: a second daemon + a report server scraping
-        #      both -> one merged Perfetto trace, one labeled
-        #      exposition
+        # ---- the fleet: a second daemon behind a managed router +
+        #      a report server scraping the DYNAMIC registry -> one
+        #      merged Perfetto trace, one labeled exposition, affinity
+        #      verified by cache-hit counters, autoscaler decision log
         import tempfile
+        from types import SimpleNamespace
 
+        from mlcomp_tpu.fleet import (
+            Autoscaler,
+            AutoscalePolicy,
+            CallableLauncher,
+            ReplicaManager,
+            ReplicaSpec,
+            Router,
+            make_router_http_server,
+        )
+        from mlcomp_tpu.obs.metrics import Registry as ObsRegistry
         from mlcomp_tpu.report.server import start_in_thread
 
         svc2 = GenerationService(
             model, {"params": params}, batch_sizes=(1,),
             prompt_buckets=(16,), max_new_buckets=(8,),
+            prefix_cache=True, prefill_chunk=8,
             metrics_history_interval=0,
         )
         httpd2 = make_http_server(svc2, "127.0.0.1", 0, "obs-check-2")
@@ -503,12 +540,45 @@ def run(n_requests: int = 3) -> dict:
         base2 = f"http://127.0.0.1:{httpd2.server_address[1]}"
         saved_env = {
             k: os.environ.get(k)
-            for k in ("MLCOMP_TPU_SERVE_URLS", "MLCOMP_TPU_SERVE_URL")
+            for k in ("MLCOMP_TPU_SERVE_URLS", "MLCOMP_TPU_SERVE_URL",
+                      "MLCOMP_TPU_SERVE_REGISTRY")
         }
         report_srv = None
+        mgr = router = rhttpd = None
         try:
             generate([3, 4, 5, 6], at=base2)
-            os.environ["MLCOMP_TPU_SERVE_URLS"] = f"{base},{base2}"
+            # the manager adopts both daemons as a two-replica set and
+            # publishes them into the JSON registry the report server
+            # reads (MLCOMP_TPU_SERVE_URLS' dynamic successor; the env
+            # var remains the static fallback)
+            reg_path = tempfile.mktemp(suffix=".json")
+            fleet_urls = {"fleet-0": base, "fleet-1": base2}
+            fleet_svcs = {"fleet-0": svc, "fleet-1": svc2}
+            fleet_reg = ObsRegistry()
+            mgr = ReplicaManager(
+                CallableLauncher(lambda name, port: SimpleNamespace(
+                    url=fleet_urls[name], stop=lambda: None,
+                )),
+                ReplicaSpec(target=2, health_poll_s=0.2),
+                metrics=fleet_reg, registry_path=reg_path,
+            )
+            mgr.tick()
+            assert mgr.stats()["live"] == 2, mgr.stats()
+            router = Router(manager=mgr, metrics=fleet_reg,
+                            health_poll_s=0.2)
+            router.poll_once()
+            scaler = Autoscaler(
+                AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                sustain_s=0.0, cooldown_s=0.0),
+                manager=mgr, metrics=fleet_reg, dry_run=True,
+            )
+            rhttpd = make_router_http_server(router, "127.0.0.1", 0)
+            threading.Thread(
+                target=rhttpd.serve_forever, daemon=True
+            ).start()
+            rrbase = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+            os.environ.pop("MLCOMP_TPU_SERVE_URLS", None)
+            os.environ["MLCOMP_TPU_SERVE_REGISTRY"] = reg_path
             report_srv, rport = start_in_thread(
                 tempfile.mktemp(suffix=".sqlite")
             )
@@ -550,12 +620,104 @@ def run(n_requests: int = 3) -> dict:
             assert all("daemon=" in k for k in req_rows), req_rows
             ups = fs["mlcomp_fleet_daemon_up"]
             assert sorted(ups.values()) == [1.0, 1.0], ups
+
+            # ---- the router end to end: a traced request lands in
+            #      /fleet/trace under the REPLICA that served it
+            def via_router(ids, headers=None):
+                body = json.dumps(
+                    {"prompt": ids, "max_new_tokens": 4}
+                ).encode()
+                req = urllib.request.Request(
+                    f"{rrbase}/generate", data=body,
+                    headers={"Content-Type": "application/json",
+                             **(headers or {})},
+                )
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    return (
+                        json.loads(r.read()),
+                        r.headers.get("x-mlcomp-replica"),
+                    )
+            tid3 = "1bad5eed5eed5eed5eed5eed5eed5eed"
+            out3, served_by = via_router(shared + [91], headers={
+                "traceparent": f"00-{tid3}-00f067aa0ba902b7-01",
+            })
+            assert out3["trace_id"] == tid3, out3
+            assert served_by in fleet_urls, served_by
+            # the replica's daemon name -> its pid in the merged view
+            daemon3 = fleet_urls[served_by].split("://", 1)[-1]
+            served_pid = {v: k for k, v in pnames.items()}[daemon3]
+            f3 = json.loads(
+                get(f"/fleet/trace?trace_id={tid3}", at=rbase)
+            )
+            f3nm = [e for e in f3["traceEvents"] if e["ph"] != "M"]
+            assert f3nm, "router-traced request left no fleet spans"
+            assert all(e["pid"] == served_pid for e in f3nm), (
+                served_pid, f3nm[:3],
+            )
+
+            # ---- affinity: the SAME prefix re-lands on the same
+            #      replica and hits its warmed cache (cache-hit-token
+            #      counters are the proof)
+            p_aff = shared + [92]
+            _, first_rep = via_router(p_aff)
+            fleet_svcs[first_rep].prefix_cache.flush()
+            out_rep, again_rep = via_router(p_aff)
+            assert again_rep == first_rep, (first_rep, again_rep)
+            assert out_rep.get("cache_hit_tokens", 0) > 0, out_rep
+            rst = router.status()
+            assert rst["counts"]["reason"]["affinity"] >= 1, rst
+
+            # ---- the new metric families scrape clean from the
+            #      router's shared fleet registry
+            ftext2 = get("/metrics", at=rrbase).decode()
+            fs2, ft2 = parse_exposition(ftext2)
+            missing = [
+                m for m in DOCUMENTED_FLEET_METRICS if m not in ft2
+            ]
+            assert not missing, f"fleet metrics absent: {missing}"
+            assert fs2["mlcomp_fleet_replicas_live"][""] == 2, fs2
+            ok_reqs = fs2["mlcomp_fleet_router_requests_total"][
+                '{outcome="ok"}'
+            ]
+            assert ok_reqs >= 3, fs2["mlcomp_fleet_router_requests_total"]
+
+            # ---- autoscaler: the decision log responds to an
+            #      injected burn-rate breach (dry-run: logged and
+            #      counted, target untouched)
+            from mlcomp_tpu.fleet.autoscale import FleetSignals
+
+            live_decision = scaler.run_tick(urls=list(
+                fleet_urls.values()
+            ))
+            assert live_decision["signals"]["live_replicas"] == 2, (
+                live_decision
+            )
+            breach = scaler.observe(FleetSignals(
+                slo_breached=True, requests_delta=10, live_replicas=2,
+            ))
+            assert breach["direction"] == "up", breach
+            assert breach["reason"] == "slo_burn", breach
+            assert breach["dry_run"] and not breach["applied"], breach
+            assert mgr.stats()["target"] == 2  # dry run never applies
+            ftext3 = get("/metrics", at=rrbase).decode()
+            fs3, _ = parse_exposition(ftext3)
+            ups_dec = fs3["mlcomp_fleet_autoscale_decisions_total"][
+                '{direction="up"}'
+            ]
+            assert ups_dec >= 1, fs3
         finally:
             for k, v in saved_env.items():
                 if v is None:
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+            if rhttpd is not None:
+                rhttpd.shutdown()
+                rhttpd.server_close()
+            if router is not None:
+                router.close()
+            if mgr is not None:
+                mgr.close(stop_replicas=False)
             if report_srv is not None:
                 report_srv.shutdown()
                 report_srv.server_close()
@@ -576,6 +738,11 @@ def run(n_requests: int = 3) -> dict:
             "trace_filter_events": len(non_meta),
             "fleet_daemons": len(pnames),
             "fleet_trace_events": len(fevs),
+            "router_requests_ok": int(ok_reqs),
+            "router_affinity_routes": int(
+                rst["counts"]["reason"]["affinity"]
+            ),
+            "autoscale_decision": breach["direction"],
         }
     finally:
         httpd.shutdown()
