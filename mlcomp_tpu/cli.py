@@ -299,6 +299,20 @@ def _cmd_average(args: argparse.Namespace) -> int:
     return 0
 
 
+def _steps_per_dispatch(value: str):
+    """argparse type for --steps-per-dispatch: an int pins K, the
+    literal 'adaptive' selects the ladder controller (the default when
+    the flag is absent)."""
+    if value.strip().lower() == "adaptive":
+        return "adaptive"
+    try:
+        return int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'adaptive', got {value!r}"
+        )
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     import yaml
 
@@ -763,12 +777,16 @@ def main(argv=None) -> int:
         " off the fat-block decode GEMV layout",
     )
     sv.add_argument(
-        "--steps-per-dispatch", type=int, default=None,
+        "--steps-per-dispatch", type=_steps_per_dispatch, default=None,
         help="continuous batcher: decode steps per compiled dispatch"
-        " (K, default 4) — one host dispatch per K tokens; joins land"
-        " at dispatch boundaries, so K bounds the extra join latency."
-        " Dead under --engine-spec-k (speculation replaces the K-step"
-        " scan)",
+        " (K) — one host dispatch per K tokens; joins land at dispatch"
+        " boundaries, so K bounds the extra join latency.  Default"
+        " 'adaptive': the drive loop picks K per boundary from the"
+        " live queue-depth/occupancy signals over a warmed 1/2/4/8"
+        " ladder (shallow queues small K for TTFT, deep queues large K"
+        " for amortization; tokens are bit-identical under any K"
+        " schedule).  An integer PINS K — the bisect override.  Dead"
+        " under --engine-spec-k (speculation replaces the K-step scan)",
     )
     sv.add_argument(
         "--engine-pipeline-depth", type=int, default=None,

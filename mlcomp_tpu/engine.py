@@ -41,6 +41,14 @@ boundaries, so K bounds the extra join latency at K-1 steps.  K=1
 recovers the round-4 per-token behavior exactly.  ``bench.py``'s
 engine section measures the per-dispatch overhead and the K
 amortization with the in-process A/B methodology (SURVEY §6).
+Since the adaptive-K PR the serve default is
+``steps_per_dispatch="adaptive"``: a hysteretic ladder controller
+(``dispatch_control.py``) re-picks K at every boundary from the live
+queue-depth/occupancy signals — shallow queues small K (TTFT), deep
+queues large K (amortization) — over a warmup-precompiled program
+ladder; emitted tokens are bit-identical under ANY K schedule because
+each request's sampling stream is keyed by (engine seed, request,
+token position), never by dispatch grouping.
 
 Async dispatch pipeline (this PR, BENCH_r05's ~98 ms host tunnel per
 dispatch next to ~29 ms of device compute): the drive loop keeps up to
@@ -270,7 +278,7 @@ class DecodeEngine:
         pad_id: int = 0,
         quant_kernel: bool = False,
         seed: int = 0,
-        steps_per_dispatch: Optional[int] = None,
+        steps_per_dispatch: "Optional[int | str]" = None,
         prefill_chunk: int = 256,
         mesh=None,
         spec_k: Optional[int] = None,
@@ -284,6 +292,7 @@ class DecodeEngine:
         kv_page_tokens: Optional[int] = None,
         kv_pages: Optional[int] = None,
         max_slots: Optional[int] = None,
+        k_ladder: Optional[Sequence[int]] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -294,12 +303,73 @@ class DecodeEngine:
         self.max_new_cap = int(max_new_cap)
         self.pad_id = int(pad_id)
         self.quant_kernel = bool(quant_kernel)
+        # steps_per_dispatch: an int PINS K (the bisect mode and the
+        # bench's fixed arms); "adaptive" runs the load-to-K ladder
+        # controller (dispatch_control.AdaptiveKController) — shallow
+        # queues pick small K (TTFT), deep queues large K (dispatch
+        # amortization), hysteresis keeps the precompiled ladder warm.
+        # Tokens are bit-identical under ANY K schedule by
+        # construction (each request's sampling keys derive from
+        # (engine rng, request seed, token position) — see
+        # _fresh_dstate's rseed; a GLOBAL step counter would NOT be
+        # K-invariant, because a row's activation boundary depends on
+        # K under mid-stream admission — and the scan body at K is
+        # the K=1 body iterated), so adaptivity moves time, never
+        # tokens.
         # None = resolve by mode: 4 for the K-step scan dispatch, 1 for
         # a speculative engine (whose dispatch verifies spec_k+1
-        # positions in ONE forward and never reads this knob)
+        # positions in ONE forward and never reads this knob).
+        from mlcomp_tpu.dispatch_control import (
+            DEFAULT_LADDER,
+            AdaptiveKController,
+        )
+
+        adaptive = (
+            isinstance(steps_per_dispatch, str)
+            and steps_per_dispatch.strip().lower() == "adaptive"
+        )
+        if isinstance(steps_per_dispatch, str) and not adaptive:
+            raise ValueError(
+                "steps_per_dispatch must be an int, None, or "
+                f"'adaptive'; got {steps_per_dispatch!r}"
+            )
+        if adaptive and spec_k is not None:
+            # a speculative dispatch verifies spec_k+1 positions in
+            # one forward and never runs the K-step scan — same
+            # dead-knob contract as a pinned K != 1 (which warns
+            # below); say so HERE, because the fallback to K=1 would
+            # otherwise dodge that warning and drop adaptivity (and
+            # any k_ladder) with zero feedback
+            warnings.warn(
+                f"spec_k={spec_k} engines ignore "
+                "steps_per_dispatch='adaptive' (a speculative dispatch "
+                "drafts and verifies spec_k+1 positions in one forward "
+                "— there is no K-step scan to adapt); drop the knob "
+                "or spec_k",
+                stacklevel=2,
+            )
+            adaptive = False
+            steps_per_dispatch = None
+            k_ladder = None  # covered by the warning above
+        self._k_controller = None
+        if adaptive:
+            ladder = tuple(
+                int(k) for k in (k_ladder or DEFAULT_LADDER)
+            )
+            self._k_controller = AdaptiveKController(ladder)
+            self.k_ladder = self._k_controller.ladder
+            steps_per_dispatch = self.k_ladder[0]
+        elif k_ladder is not None:
+            raise ValueError(
+                "k_ladder only applies to steps_per_dispatch="
+                "'adaptive' (got a pinned/default steps_per_dispatch)"
+            )
         if steps_per_dispatch is None:
             steps_per_dispatch = 1 if spec_k is not None else 4
         self.steps_per_dispatch = int(steps_per_dispatch)
+        if not adaptive:
+            self.k_ladder = (self.steps_per_dispatch,)
+        self.adaptive_k = adaptive
         if self.steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
         if spec_k is not None and self.steps_per_dispatch != 1:
@@ -637,6 +707,9 @@ class DecodeEngine:
             "deadline_exceeded": 0, "cancelled": 0, "cache_degraded": 0,
             "watchdog_stalls": 0, "watchdog_restarts": 0,
             "profile_captures": 0,
+            # adaptive-K: controller switches of steps_per_dispatch
+            # (0 forever on pinned-K engines)
+            "dispatch_k_changes": 0,
         }
         if self.spec_k is not None:
             # spec-honesty denominator: live row-forwards across spec
@@ -655,12 +728,19 @@ class DecodeEngine:
             self._stats["kv_pages_lazy_allocated"] = 0
             self._stats["kv_decode_page_failures"] = 0
         self._spec_warned = False
+        # sticky spec-honesty verdict: flips True (and stays) when
+        # measured acceptance is <= 1.0 past the 64-row window — the
+        # bit behind /healthz's spec_ineffective and the
+        # mlcomp_engine_spec_ineffective gauge
+        self._spec_ineffective = False
         self._fatblock_scale_warned = False
         # issued-but-unprocessed dispatches, oldest first: (packed
         # device buffer, host issue time, dispatch seq — the flight
-        # recorder's async-span id).  Owned by the loop thread;
-        # close()'s normal path touches it only after the join.
-        self._inflight: Deque[Tuple[Any, float, int]] = deque()  # guarded_by: loop [writes]
+        # recorder's async-span id — and the step depth it was issued
+        # at, for the lazy page allocator's mixed-K lookahead).  Owned
+        # by the loop thread; close()'s normal path touches it only
+        # after the join.
+        self._inflight: Deque[Tuple[Any, float, int, int]] = deque()  # guarded_by: loop [writes]
         # overlap accounting: hidden_ms is host work done between a
         # dispatch's issue and the host blocking on its outputs (the
         # time the pipeline hid behind device compute), wait_ms the
@@ -756,16 +836,15 @@ class DecodeEngine:
                 for leaf in jax.tree.leaves(self._dstate["cache"])
             )
         )
-        self._forwards = (
-            1 if self.spec_k is not None else self.steps_per_dispatch
-        )
         self._hbm_gbps = float(os.environ.get("MLCOMP_TPU_HBM_GBPS", "819"))
         self.step_count = 0
         self._fns: Dict[Any, Any] = {}
-        # chunk widths whose fused program has COMPILED AND RUN once
-        # (warmup or first-use warming) — tracked separately from _fns
-        # because building the jit wrapper is not compiling it
+        # (chunk width, K) pairs whose fused program has COMPILED AND
+        # RUN once (warmup or first-use warming) — tracked separately
+        # from _fns because building the jit wrapper is not compiling
+        # it; _dispatch_warmed is the plain-dispatch ladder's analogue
         self._fused_warmed: set = set()
+        self._dispatch_warmed: set = set()
         self._stop = threading.Event()
         # watchdog state: _busy_since marks the host time the loop
         # thread entered a potentially-wedging call (dispatch issue,
@@ -846,6 +925,16 @@ class DecodeEngine:
             "p": jnp.ones((ns,), jnp.float32),
             "rp": jnp.ones((ns,), jnp.float32),
             "rng": jax.random.PRNGKey(self._seed),
+            # per-slot REQUEST seed (the rid, set at insert): the scan
+            # dispatch derives row r's sampling key for its token at
+            # position p as fold_in(fold_in(rng, rseed[r]), p), so a
+            # request's sampled stream depends only on (engine seed,
+            # request, token index) — NEVER on how steps were grouped
+            # into dispatches, when neighbours joined, or pipeline
+            # depth.  This is what makes emitted tokens bit-identical
+            # under any adaptive-K schedule; the greedy path never
+            # reads it, and the spec dispatch carries it untouched.
+            "rseed": jnp.zeros((ns,), jnp.int32),
         }
         if self.spec_k is not None:
             dstate["ids"] = jnp.zeros((ns, self.t_ids), jnp.int32)
@@ -1134,7 +1223,11 @@ class DecodeEngine:
             "queue_depth": self._queue.qsize() + len(self._pending),
             "active_slots": active,
             "slots": self.slots,
+            # the CURRENT dispatch depth (adaptive engines move it);
+            # adaptive_k/k_ladder say whether and over what it moves
             "steps_per_dispatch": self.steps_per_dispatch,
+            "adaptive_k": self.adaptive_k,
+            "k_ladder": list(self.k_ladder),
             "prefill_chunk": self.prefill_chunk,
             "fused_admission": self.fused_admission,
             "kv_layout": self.kv_layout,
@@ -1159,6 +1252,11 @@ class DecodeEngine:
                 "spec_net_gain": (
                     round(acc - 1.0, 3) if acc is not None else None
                 ),
+                # persistent operator flag: measured acceptance fell
+                # to <= 1 token/row/forward past the 64-row warning
+                # window — speculation is burning fat-block rows for
+                # nothing (sticky until restart; /healthz surfaces it)
+                "spec_ineffective": self._spec_ineffective,
             }
         out["watchdog"] = {
             "dispatch_stall_timeout_s": self.dispatch_stall_timeout,
@@ -1258,6 +1356,19 @@ class DecodeEngine:
                 "Accepted tokens per row per verify forward minus 1 "
                 "(<= 0: speculation is a measured net loss)",
                 st["emitted_tokens"] / st["spec_rows"] - 1.0)
+        if self.spec_k is not None:
+            gau("mlcomp_engine_spec_ineffective",
+                "1 once measured acceptance fell to <= 1 token/row/"
+                "forward past the 64-row window (sticky): speculation "
+                "is burning fat-block rows for nothing",
+                1 if self._spec_ineffective else 0)
+        gau("mlcomp_engine_dispatch_k",
+            "Decode steps per dispatch currently in effect (the "
+            "adaptive controller's pick, or the pinned K)",
+            self.steps_per_dispatch)
+        ctr("mlcomp_engine_dispatch_k_changes_total",
+            "Adaptive-K controller switches of steps_per_dispatch",
+            st["dispatch_k_changes"])
         ctr("mlcomp_engine_latency_samples_total",
             "Requests behind the TTFT percentiles (lifetime)",
             self._lat_ttft_n)
@@ -1618,14 +1729,32 @@ class DecodeEngine:
                 n += 1
         return n
 
+    def warm_dispatch_fns(self) -> int:
+        """Precompile the K LADDER's plain dispatch programs (service
+        warmup): one compile per rung on an adaptive engine, so a
+        controller switch mid-serving is a dict lookup, never a
+        loop-thread compile stall.  Pinned engines warm their one K.
+        Runs on THROWAWAY carries — the donated input is a fresh
+        ``_fresh_dstate`` the drive loop never owned."""
+        n = 0
+        for k in self.k_ladder:
+            if ("dispatch", k) in self._fns and k in self._dispatch_warmed:
+                continue
+            out = self._dispatch_fn(k)(self.variables, self._fresh_dstate())
+            np.asarray(out[1][0, 0, 0])  # block until it really ran
+            self._dispatch_warmed.add(k)
+            n += 1
+        return n
+
     def warm_fused_fns(self) -> int:
         """Precompile the fused prefill+decode program per distinct
-        chunk width (service warmup).  Unlike the prefix-cache programs
-        these DO trace the model, so each costs a real compile — paid
-        here instead of on the loop thread at the first overlapped
-        admission mid-serving.  Runs on THROWAWAY state: the jit cache
-        keys on shapes/dtypes, so a dummy call seeds it and nothing
-        the drive loop owns is touched (safe to call while it idles)."""
+        chunk width — per ladder rung on adaptive engines — (service
+        warmup).  Unlike the prefix-cache programs these DO trace the
+        model, so each costs a real compile — paid here instead of on
+        the loop thread at the first overlapped admission mid-serving.
+        Runs on THROWAWAY state: the jit cache keys on shapes/dtypes,
+        so a dummy call seeds it and nothing the drive loop owns is
+        touched (safe to call while it idles)."""
         if not self.fused_admission:
             return 0
         jnp = self._jnp
@@ -1637,23 +1766,27 @@ class DecodeEngine:
             widths.add(c)
         n = 0
         for c in sorted(widths):
-            if c not in self._fused_warmed:
-                self._warm_fused_width(c)
-                n += 1
+            for k in self.k_ladder:
+                if (c, k) not in self._fused_warmed:
+                    self._warm_fused_width(c, k)
+                    n += 1
         return n
 
-    def _warm_fused_width(self, c: int) -> None:
+    def _warm_fused_width(self, c: int, k: Optional[int] = None) -> None:
         """Compile (and run once, on throwaway state) the fused program
-        for chunk width ``c`` — the jit cache keys on shapes, so the
-        dummy call seeds it and the real donating call never compiles.
-        Also the loop's first-use path (``_prep_fused_chunk``): there a
-        compile failure stays ADMISSION-scoped — parity with the
-        staged path, whose ``_prefill_chunk_fn`` compile errors only
-        ever failed the joiner — because this call touches nothing the
-        fleet depends on; only the real call's failure is engine-level
-        (it donates the live carry)."""
+        for chunk width ``c`` at dispatch depth ``k`` — the jit cache
+        keys on shapes, so the dummy call seeds it and the real
+        donating call never compiles.  Also the loop's first-use path
+        (``_prep_fused_chunk``): there a compile failure stays
+        ADMISSION-scoped — parity with the staged path, whose
+        ``_prefill_chunk_fn`` compile errors only ever failed the
+        joiner — because this call touches nothing the fleet depends
+        on; only the real call's failure is engine-level (it donates
+        the live carry)."""
         jnp = self._jnp
-        out = self._fused_dispatch_fn(c)(
+        if k is None:
+            k = self.steps_per_dispatch
+        out = self._fused_dispatch_fn(c, k)(
             self.variables, self._fresh_dstate(),
             self._prefill_init_fn()(jnp.int32(0)),
             jnp.zeros((1, c), jnp.int32),
@@ -1661,7 +1794,7 @@ class DecodeEngine:
             jnp.ones((1, self.l_buf), jnp.bool_),
         )
         np.asarray(out[2][0, 0])  # block until it really ran
-        self._fused_warmed.add(c)
+        self._fused_warmed.add((c, k))
 
     def _prefill_chunk_fn(self, c: int):
         """One bounded prefill chunk: (1, c) tokens forward against the
@@ -1734,7 +1867,7 @@ class DecodeEngine:
                     ("kv_start", jnp.int32), ("remaining", jnp.int32),
                     ("eos", jnp.int32), ("t", jnp.float32),
                     ("k", jnp.int32), ("p", jnp.float32),
-                    ("rp", jnp.float32),
+                    ("rp", jnp.float32), ("rseed", jnp.int32),
                 ]):
                     out[key] = dstate[key].at[slot].set(
                         packed[i + 1].astype(dt)
@@ -1742,7 +1875,7 @@ class DecodeEngine:
                 if spec:  # token history seeds the n-gram draft
                     out["ids"] = dstate["ids"].at[slot].set(ids_row[0][0])
                     out["ids_len"] = dstate["ids_len"].at[slot].set(
-                        packed[10].astype(jnp.int32)
+                        packed[11].astype(jnp.int32)
                     )
                 out["active"] = dstate["active"].at[slot].set(True)
                 return out
@@ -1840,7 +1973,12 @@ class DecodeEngine:
         pool = self._pool
         T = pool.page_tokens
         jnp = self._jnp
-        lookahead = self._steps_hi() * (len(self._inflight) + 1) + 1
+        # in-flight dispatches advance by the depth THEY were issued
+        # at (adaptive K may have moved since); the dispatch about to
+        # issue advances by the current one
+        lookahead = sum(
+            steps for _, _, _, steps in self._inflight
+        ) + self._steps_hi() + 1
         grew = False
         for i, sl in enumerate(self._host):
             if sl is None or sl.span_end is None:
@@ -1905,7 +2043,7 @@ class DecodeEngine:
 
     _PER_SLOT_KEYS = (
         "last_logits", "presence", "cursors", "kv_start", "positions",
-        "active", "remaining", "eos", "t", "k", "p", "rp",
+        "active", "remaining", "eos", "t", "k", "p", "rp", "rseed",
     )
 
     def _slot_span(self, s_bucket: int, n_ids: int,
@@ -2008,7 +2146,7 @@ class DecodeEngine:
                 "last_logits": 0.0, "presence": False, "cursors": 0,
                 "kv_start": 0, "positions": 0, "active": False,
                 "remaining": 0, "eos": -1, "t": 0.0, "k": self.vocab,
-                "p": 1.0, "rp": 1.0, "table": GRAVE_PAGE,
+                "p": 1.0, "rp": 1.0, "rseed": 0, "table": GRAVE_PAGE,
             }
             if self.spec_k is not None:
                 fills["ids"] = 0
@@ -2128,7 +2266,7 @@ class DecodeEngine:
             return None
         return self._pending.popleft()
 
-    def _dispatch_fn(self):
+    def _dispatch_fn(self, k: Optional[int] = None):
         """K single-token steps in one lax.scan — one host dispatch and
         one host sync per K tokens (r4 verdict missing #1).  Per-row
         early exit: a row whose budget or EOS lands mid-scan stops
@@ -2142,29 +2280,38 @@ class DecodeEngine:
         steps' (tokens, logprobs, valid) come back as ONE (3, K, slots)
         f32 array — a steady-state dispatch moves no per-step operands
         host->device and fetches one buffer back (token ids < 2^24 are
-        exact in f32)."""
-        if "dispatch" not in self._fns:
-            self._fns["dispatch"] = self._jax.jit(
-                self._carry_core(), donate_argnums=(1,)
-            )
-        return self._fns["dispatch"]
+        exact in f32).
 
-    def _dispatch_core(self):
+        The family is K-KEYED: an adaptive engine cycles through a
+        small warmed ladder of compiled programs (one per rung,
+        precompiled by ``warm_dispatch_fns``) instead of recompiling —
+        a K switch is a dict lookup at the next issue."""
+        if k is None:
+            k = self.steps_per_dispatch
+        key = ("dispatch", k)
+        if key not in self._fns:
+            self._fns[key] = self._jax.jit(
+                self._carry_core(k), donate_argnums=(1,)
+            )
+        return self._fns[key]
+
+    def _dispatch_core(self, k: int):
         """The raw ``(variables, dstate) -> (dstate', packed)`` dispatch
         body — K-step scan, or speculative verify when ``spec_k`` is
         set — shared by the plain jitted dispatch AND the fused
         prefill+decode program family: the fused trace embeds this SAME
         function, so decode math, scan order, and the RNG stream are
         identical across the two paths by construction."""
-        if "dispatch_core" not in self._fns:
-            self._fns["dispatch_core"] = (
+        key = ("dispatch_core", k)
+        if key not in self._fns:
+            self._fns[key] = (
                 self._build_spec_dispatch_core()
                 if self.spec_k is not None
-                else self._build_scan_dispatch_core()
+                else self._build_scan_dispatch_core(k)
             )
-        return self._fns["dispatch_core"]
+        return self._fns[key]
 
-    def _carry_core(self):
+    def _carry_core(self, k: int):
         """The dispatch body over the engine's CARRY layout: the raw
         core for the dense layout.  For the paged layout the carry is
         pages + table + cache scalars, and the data path is the
@@ -2184,12 +2331,13 @@ class DecodeEngine:
         plain jitted dispatch AND the fused prefill+decode family,
         like the raw core itself."""
         if self._layout is None:
-            return self._dispatch_core()
-        if "carry_core" not in self._fns:
-            core = self._dispatch_core()
+            return self._dispatch_core(k)
+        key = ("carry_core", k)
+        if key not in self._fns:
+            core = self._dispatch_core(k)
             if self._paged_attn != "lax":
                 # FUSED: the core consumes the paged carry directly
-                self._fns["carry_core"] = core
+                self._fns[key] = core
                 return core
             layout = self._layout
             impl = self._page_gather_impl
@@ -2212,8 +2360,8 @@ class DecodeEngine:
                 out2["cache_scalars"] = layout.scalars_of(out["cache"])
                 return out2, packed
 
-            self._fns["carry_core"] = paged
-        return self._fns["carry_core"]
+            self._fns[key] = paged
+        return self._fns[key]
 
     def _kv_fused(self) -> bool:
         """True when the dispatch cores run the FUSED paged data path
@@ -2261,7 +2409,7 @@ class DecodeEngine:
 
         return forward
 
-    def _fused_dispatch_fn(self, c: int):
+    def _fused_dispatch_fn(self, c: int, k: Optional[int] = None):
         """FUSED prefill+decode dispatch: one donated program that runs
         the usual dispatch body over all active slots AND one ``(1, c)``
         prefill chunk against the pending admission's carried cache.
@@ -2269,12 +2417,15 @@ class DecodeEngine:
         from HBM once per dispatch instead of once for decode plus once
         for a staged chunk, and the chunk costs no extra host dispatch
         at a drained boundary.  One program per distinct chunk width
-        per dispatch family (scan K or spec verify) — the same compile
-        budget shape as the staged ``_prefill_chunk_fn``."""
-        key = ("fused_dispatch", c)
+        per dispatch family (scan K — one per ladder rung on adaptive
+        engines — or spec verify) — the same compile budget shape as
+        the staged ``_prefill_chunk_fn``."""
+        if k is None:
+            k = self.steps_per_dispatch
+        key = ("fused_dispatch", c, k)
         if key not in self._fns:
             jnp = self._jnp
-            core = self._carry_core()
+            core = self._carry_core(k)
 
             def fused(variables, dstate, adm_cache, chunk, positions,
                       kv_mask):
@@ -2293,11 +2444,10 @@ class DecodeEngine:
             self._fns[key] = self._jax.jit(fused, donate_argnums=(1, 2))
         return self._fns[key]
 
-    def _build_scan_dispatch_core(self):
+    def _build_scan_dispatch_core(self, K: int):
         jax, jnp = self._jax, self._jnp
-        from mlcomp_tpu.models.generation import sample_token_rowwise
+        from mlcomp_tpu.models.generation import sample_token_rowwise_keyed
 
-        K = self.steps_per_dispatch
         fused_kv = self._kv_fused()
 
         def dispatch(variables, dstate):
@@ -2318,8 +2468,17 @@ class DecodeEngine:
             # paged) the page tuple — attention then reads/writes
             # through the table via the kvpool context
             forward = self._kv_forward_fn(variables, dstate)
+            # per-REQUEST sampling streams (K-schedule invariance):
+            # row r's key for the token at position p is
+            # fold_in(fold_in(rng, rseed[r]), p) — a pure function of
+            # (engine seed, request, token index), so any grouping of
+            # steps into dispatches samples identical tokens.  Greedy
+            # rows never evaluate the keys (lax.cond in the sampler).
+            req_keys = jax.vmap(
+                lambda s: jax.random.fold_in(dstate["rng"], s)
+            )(dstate["rseed"])
 
-            def one_step(carry, sub):
+            def one_step(carry, _):
                 (kv, last_logits, presence, cursors, positions,
                  live, remaining) = carry
                 raw = last_logits
@@ -2332,7 +2491,12 @@ class DecodeEngine:
                     )
 
                 adj = jax.lax.cond(penalty_on, penalized, lambda: raw)
-                tok = sample_token_rowwise(sub, adj, t_row, k_row, p_row)
+                step_keys = jax.vmap(jax.random.fold_in)(
+                    req_keys, positions
+                )
+                tok = sample_token_rowwise_keyed(
+                    step_keys, adj, t_row, k_row, p_row
+                )
                 tok = jnp.where(live, tok, jnp.int32(self.pad_id))
                 lp = jnp.take_along_axis(
                     jax.nn.log_softmax(raw, axis=-1), tok[:, None],
@@ -2357,8 +2521,6 @@ class DecodeEngine:
                 )
                 return carry2, (tok, lp, live)
 
-            rng, sub = jax.random.split(dstate["rng"])
-            subs = jax.random.split(sub, K)
             kv0 = (
                 tuple(dstate["pages"]) if fused_kv else dstate["cache"]
             )
@@ -2369,7 +2531,7 @@ class DecodeEngine:
                 dstate["remaining"],
             )
             carry, (toks, lps, valid) = jax.lax.scan(
-                one_step, carry0, subs
+                one_step, carry0, None, length=K
             )
             out = dict(dstate)
             (kv_out, out["last_logits"], out["presence"],
@@ -2379,7 +2541,6 @@ class DecodeEngine:
                 out["pages"] = list(kv_out)
             else:
                 out["cache"] = kv_out
-            out["rng"] = rng
             packed = jnp.stack([
                 toks.astype(jnp.float32),
                 lps.astype(jnp.float32),
@@ -2721,12 +2882,12 @@ class DecodeEngine:
         failure fails only the joiner (service warmup normally
         precompiles and makes this a set lookup)."""
         _inject_fault("engine.fused_prefill")
-        if adm.chunk not in self._fused_warmed:
+        if (adm.chunk, self.steps_per_dispatch) not in self._fused_warmed:
             # compile is busy time to the watchdog, like every other
             # potentially-wedging device call on this thread
             self._busy_since = time.perf_counter()
             try:
-                self._warm_fused_width(adm.chunk)
+                self._warm_fused_width(adm.chunk, self.steps_per_dispatch)
             finally:
                 self._busy_since = None
         jnp = self._jnp
@@ -3052,6 +3213,13 @@ class DecodeEngine:
 
     # -------------------------------------------------- bytes accounting
 
+    @property
+    def _forwards(self) -> int:
+        """Model forwards one dispatch runs — K for the scan dispatch
+        (the CURRENT K: adaptive engines re-price the roofline as the
+        controller moves), 1 for a spec verify."""
+        return 1 if self.spec_k is not None else self.steps_per_dispatch
+
     def _kv_live_bytes(self) -> int:
         """Paged: bytes of the live page MAPPINGS — the KV working set
         a fused forward actually reads through the tables, counted per
@@ -3169,6 +3337,14 @@ class DecodeEngine:
             slot, s_bucket, len(req["ids"]), s_bucket - len(req["ids"]),
             req["n_new"], req["eos_id"], req["temperature"], req["top_k"],
             req["top_p"], req["repetition_penalty"],
+            # per-request sampling-stream seed: the rid wrapped to
+            # stay exact through the f32 packed row (2^23 < 2^24).
+            # Uniqueness is only needed among CONCURRENTLY ACTIVE
+            # sampled requests — two live rows 8.4M rids apart cannot
+            # coexist in a bounded slot pool, so the wrap never
+            # collides live streams; warmup rows are greedy and never
+            # read it.
+            req.get("rid", 0) % (1 << 23),
             len(req["ids"]),  # ids_len (spec mode; ignored otherwise)
         ], np.float32)
         extra = ()
@@ -3398,7 +3574,13 @@ class DecodeEngine:
                 fused[0].chunk if fused is not None else None
             )
             pr["families"][fam] = pr["families"].get(fam, 0) + 1
-        self._inflight.append((packed, time.perf_counter(), seq))
+        # carry the dispatch's OWN step depth: adaptive K can change
+        # between issues, and the lazy page allocator's lookahead must
+        # price the in-flight window by what each dispatch will
+        # actually advance, not by the current knob
+        self._inflight.append(
+            (packed, time.perf_counter(), seq, self._steps_hi())
+        )
         p = self._pstats
         p["issued"] += 1
         p["inflight_sum"] += len(self._inflight)
@@ -3419,7 +3601,7 @@ class DecodeEngine:
         rows.  FIFO processing keeps step numbering, stream order, and
         slot retirement identical to the synchronous loop at any
         pipeline depth."""
-        packed, t_issue, seq = self._inflight.popleft()
+        packed, t_issue, seq, _steps = self._inflight.popleft()
         t_block = time.perf_counter()
         self._busy_since = t_block
         try:
@@ -3500,6 +3682,11 @@ class DecodeEngine:
         acc = self._stats["emitted_tokens"] / self._stats["spec_rows"]
         if acc <= 1.0 + 1e-6:
             self._spec_warned = True
+            # persistent flag (sticky until restart): operators — and
+            # the autoscaler, later — read it from /healthz and the
+            # mlcomp_engine_spec_ineffective gauge instead of hoping
+            # someone saw the one-shot warning below
+            self._spec_ineffective = True
             warnings.warn(
                 f"speculative decoding (spec_k={self.spec_k}) is a "
                 f"measured net LOSS on this traffic: acceptance "
@@ -3638,6 +3825,32 @@ class DecodeEngine:
             self._finish(i, error=err)
             self._release_slot_pages(i)
 
+    def _adaptive_tick(self) -> None:  # graftcheck: runs-on(loop)
+        """Adaptive dispatch depth: one controller decision per
+        boundary from the live load signals (queue depth, slot
+        occupancy — the same signals the metrics-history ring samples
+        as ``mlcomp_engine_queue_depth`` / ``active_slots``).  A
+        switch retargets the NEXT issue at the warmed ladder program
+        for the new K; nothing drains — in-flight packed buffers carry
+        their own step depth and the resolve loop is shape-agnostic,
+        so mixed-K windows resolve FIFO like any other.  Tokens are
+        K-schedule-invariant by construction (see _fresh_dstate's
+        rseed), so the controller moves time, never tokens."""
+        ctl = self._k_controller
+        if ctl is None:
+            return
+        depth = self._queue.qsize() + len(self._pending)
+        active = sum(1 for s in self._host if s is not None)
+        k2 = ctl.decide(depth, active, len(self._host))
+        if k2 == self.steps_per_dispatch:
+            return
+        self.steps_per_dispatch = k2
+        self._stats["dispatch_k_changes"] += 1
+        self.recorder.instant(
+            "dispatch_k_change", track="engine.loop", k=k2,
+            queue_depth=depth, active=active,
+        )
+
     # -------------------------------------------------------- drive loop
 
     def _loop_body(self) -> None:  # graftcheck: runs-on(loop)
@@ -3665,6 +3878,10 @@ class DecodeEngine:
                     and all(s is None for s in self._host)
                 )
                 self._boundary_maintenance(block_s=0.2 if idle else 0.0)
+                # adaptive dispatch depth: pick this boundary's K from
+                # the live load signals BEFORE any issue below (the
+                # fused program family is K-keyed too)
+                self._adaptive_tick()
                 # on-demand device capture (GET /profile): start/stop
                 # the trace window at this boundary when one is armed
                 self._profile_tick()

@@ -165,6 +165,36 @@ def sample_token_rowwise(
     )
 
 
+def sample_token_rowwise_keyed(
+    keys: jax.Array,
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """:func:`sample_token_rowwise` with PER-ROW keys (``keys``:
+    (rows, 2) uint32): row r draws its token from its OWN key instead
+    of sharing one batch key.  The continuous engine derives row r's
+    key as ``fold_in(fold_in(engine_rng, request_seed), position)``,
+    so a request's sampled stream depends only on (engine seed,
+    request, token index) — NEVER on which dispatch carried the step,
+    how deep the pipeline ran, or when neighbours joined.  That
+    per-request stream is what makes emitted tokens bit-identical
+    under any adaptive-K schedule; the greedy fast path is unchanged."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled_branch():
+        proc = process_logits_rowwise(logits, temperature, top_k, top_p)
+        sampled = jax.vmap(jax.random.categorical)(keys, proc).astype(
+            jnp.int32
+        )
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    return jax.lax.cond(
+        jnp.any(temperature > 0.0), sampled_branch, lambda: greedy
+    )
+
+
 def prep_decode_variables(model, variables, quant_kernel, weights_dtype):
     """Decode-loop weight prep shared by ``generate`` and
     ``speculative_generate``: int8 entry-dequant or kernel-fold (with the

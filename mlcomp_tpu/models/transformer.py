@@ -348,7 +348,7 @@ class SelfAttention(nn.Module):
         to the dense engine: the kernels share ``_flash_block_update``
         and the block partition; the gather is pure data movement."""
         from mlcomp_tpu.ops.pallas.decode_attention import (
-            CHUNK_MAX_SQ,
+            chunk_uses_kernels,
             decode_attention,
             decode_attention_chunk,
             paged_decode_attention,
@@ -403,10 +403,18 @@ class SelfAttention(nn.Module):
             if dhp != dh else q
         )
         scale = 1.0 / (dh**0.5)
-        if s > CHUNK_MAX_SQ:
-            # wider than the multi-query kernel (spec_k >= 32): the
+        if not chunk_uses_kernels(s):
+            # wider than one multi-query kernel tile, off-TPU: the
             # same XLA dequant fallback the dense path takes, on
-            # gathered bytes — degrade like dense does, never crash
+            # gathered bytes — degrade like dense does, never crash.
+            # On TPU (wide_chunk_mode "pallas") wide chunks fall
+            # through to the TILED kernel routes below instead: pages
+            # stream through the table (or a gather feeds the dense
+            # kernels), closing the per-layer barrier-gather +
+            # full-buffer dequant round trip overlapped admissions
+            # used to pay here.  chunk_uses_kernels is the SHARED
+            # predicate chunk_attention_route (the bench's bytes
+            # model) consults — routing cannot drift from the model.
             k8 = ctx.gather_dense(kq_i)
             ks4 = ctx.gather_dense(ks_i)
             v8 = ctx.gather_dense(vq_i)
@@ -587,12 +595,18 @@ class SelfAttention(nn.Module):
             global-index chunked path and the per-row-cursor verify —
             the two differ only in the stop vector."""
             from mlcomp_tpu.ops.pallas.decode_attention import (
-                CHUNK_MAX_SQ,
+                chunk_uses_kernels,
                 decode_attention_chunk,
             )
             from mlcomp_tpu.ops.quant import pallas_mesh
 
-            if s <= CHUNK_MAX_SQ and pallas_mesh() is None:
+            # verify widths always ride the kernel; WIDE chunks
+            # (admission prefill) ride the query-TILED kernel sweeps
+            # when wide_chunk_mode says so (TPU default) instead of
+            # round-tripping a full bf16 copy of the cache per layer.
+            # chunk_uses_kernels is the SHARED predicate behind the
+            # bench's chunk_attention_route bytes model.
+            if chunk_uses_kernels(s, mesh=pallas_mesh() is not None):
                 qp = (
                     jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dhp - dh)))
                     if dhp != dh else q

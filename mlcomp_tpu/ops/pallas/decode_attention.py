@@ -58,6 +58,7 @@ build adds on top of it.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -377,11 +378,126 @@ def _kernel_chunk(
         _flash_finalize(o_ref, acc_ref, l_ref)
 
 
-# sublane budget for the multi-query kernel's (Hkv, Sp, dh) f32
-# scratch triple — S (chunk width) beyond this stays on the XLA
-# dequant path (big prefill chunks are bandwidth-amortized there
-# anyway; the kernel's value is the SMALL verify shape)
+# sublane budget for ONE multi-query kernel call's (Hkv, Sp, dh) f32
+# scratch triple — also the QUERY TILE for wider chunks: an S above it
+# runs ceil(S / CHUNK_MAX_SQ) kernel calls, each sweeping the live
+# window once with kv_stop0 offset by its tile's position (exact: the
+# chunk's K/V are in the cache before any attention runs, and query
+# j's stop is position-indexed).  Whether wide chunks take the tiled
+# kernels at all is wide_chunk_mode() — the XLA dequant path remains
+# the reference and the non-TPU default.
 CHUNK_MAX_SQ = 32
+
+
+def wide_chunk_mode() -> str:
+    """``MLCOMP_TPU_WIDE_CHUNK``: how chunk attention WIDER than the
+    multi-query kernel tile (S > CHUNK_MAX_SQ — admission prefill
+    chunks, spec_k >= 32) runs against an int8 KV cache.
+
+    - ``pallas``: query-TILED flash-kernel sweeps — ceil(S/32) passes
+      over the live window, dequant in VMEM, no full-buffer bf16
+      materialization.  On the paged path the tiles stream pages
+      through the table (``paged_decode_attention_chunk``), so an
+      overlapped admission's chunk stops paying the per-layer
+      barrier-gather + dense-dequant round trip;
+    - ``xla``: the dequantize-the-whole-buffer XLA path (the PR-5
+      reference — bandwidth-amortized at prefill widths, but it
+      round-trips a full bf16 copy of the cache through HBM per layer
+      per chunk);
+    - ``auto`` (default): ``pallas`` on a real TPU, ``xla`` elsewhere
+      (interpret-mode tiles would multiply CPU test wall for no
+      fidelity gain — CPU correctness is proved by the dedicated
+      interpret-mode equality tests).
+
+    The engine and bare ``generate`` read the same knob, so their
+    chunk numerics always match (the engine-vs-generate equality
+    contract); dense and paged engines route consistently too, so
+    paged-vs-dense bit-equality holds on every setting."""
+    mode = os.environ.get("MLCOMP_TPU_WIDE_CHUNK", "auto")
+    if mode not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"MLCOMP_TPU_WIDE_CHUNK must be auto/pallas/xla, got {mode!r}"
+        )
+    if mode == "auto":
+        try:
+            mode = (
+                "pallas"
+                if jax.default_backend() in ("tpu", "axon") else "xla"
+            )
+        except Exception:
+            mode = "xla"
+    return mode
+
+
+def chunk_uses_kernels(s_q: int, mesh: bool = False) -> bool:
+    """Kernel-vs-XLA half of the chunk routing — the SHARED predicate
+    the transformer's int8 chunk-attention branches and
+    :func:`chunk_attention_route` both consult, so the bench's
+    route-aware acceptance can never drift from the real data path:
+    verify widths always ride the kernels; wider chunks do when
+    :func:`wide_chunk_mode` says so; mesh-sharded serving never does
+    (the kernels are single-chip)."""
+    if mesh:
+        return False
+    return s_q <= CHUNK_MAX_SQ or wide_chunk_mode() == "pallas"
+
+
+def chunk_attention_route(s_q: int, l_buf: int, h_kv: int, dh: int,
+                          page_tokens: Optional[int] = None,
+                          mesh: bool = False) -> str:
+    """The data path an ``s_q``-wide int8-KV chunk attention takes —
+    the single source of truth behind the transformer's routing and
+    bench's route-aware bytes model.  Returns one of:
+
+    - ``kernel``        dense flash kernel(s), query-tiled past 32
+    - ``kernel_paged``  paged flash kernel(s): pages stream through
+                        the table, no dense view (eligible geometry)
+    - ``kernel_gather`` per-layer page gather feeding the DENSE
+                        kernels (paged, ineligible geometry)
+    - ``xla_dequant``   full-buffer dequantize in XLA (wide chunks
+                        off-TPU, and any mesh-sharded serving)
+    - ``gather_xla_dequant``  the same, on a gathered dense view
+                        (paged + wide + off-TPU)
+    """
+    paged = page_tokens is not None
+    if not chunk_uses_kernels(s_q, mesh=mesh):
+        return "gather_xla_dequant" if paged else "xla_dequant"
+    if not paged:
+        return "kernel"
+    if paged_block_kv(l_buf, h_kv, dh, page_tokens) is not None:
+        return "kernel_paged"
+    return "kernel_gather"
+
+
+def chunk_attention_bytes(s_q: int, l_buf: int, h_kv: int, dh: int,
+                          route: str, window: Optional[int] = None,
+                          scale_bytes: int = 2) -> int:
+    """Modeled HBM bytes ONE layer's chunk attention moves for the
+    K/V operands under ``route`` — the admission-side cost model the
+    bench's route-aware arm reports (weights/activations are
+    route-invariant and excluded).  ``window`` is the live span the
+    kernels actually sweep (kernel routes read only it; the XLA
+    routes touch the whole buffer)."""
+    win = l_buf if window is None else int(window)
+    q8 = 2 * h_kv * dh            # K+V int8 bytes per slot
+    sc = 2 * scale_bytes          # K+V scale bytes per slot
+    if route in ("kernel", "kernel_paged"):
+        tiles = max(1, -(-s_q // CHUNK_MAX_SQ))
+        return tiles * win * (q8 + sc)
+    if route == "kernel_gather":
+        # per-layer gather materializes the dense int8 view (read
+        # pages + write view), then the tiled kernels sweep it
+        tiles = max(1, -(-s_q // CHUNK_MAX_SQ))
+        return l_buf * 2 * (q8 + sc) + tiles * win * (q8 + sc)
+    bf16 = 2 * h_kv * dh * 2      # K+V bf16 dequant copy per slot
+    base = l_buf * (q8 + sc)      # read the quant buffers once
+    base += l_buf * 2 * bf16      # write the bf16 copy + read it back
+    if route == "gather_xla_dequant":
+        base += l_buf * 2 * (q8 + sc)   # the gather round trip first
+        return base
+    if route == "xla_dequant":
+        return base
+    raise ValueError(f"unknown chunk-attention route {route!r}")
 
 
 def decode_attention_chunk(
@@ -419,11 +535,25 @@ def decode_attention_chunk(
     if h % h_kv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     if s_q > CHUNK_MAX_SQ:
-        raise NotImplementedError(
-            f"chunk width {s_q} > {CHUNK_MAX_SQ}: the multi-query kernel "
-            "is sized for verify/small-chunk shapes; wider chunks take "
-            "the XLA dequant path"
+        # QUERY-TILED wide chunk (admission prefill widths): ceil(S/32)
+        # kernel sweeps, each over the same already-written cache with
+        # its tile's position folded into kv_stop0 — exact because
+        # query j's causal stop is position-indexed and the chunk's
+        # K/V landed in the cache before any attention ran.  Replaces
+        # the old NotImplementedError; whether wide chunks come here
+        # at all is the caller's wide_chunk_mode() routing.
+        stop0 = (
+            jnp.full((b,), l_buf - s_q + 1, jnp.int32) if kv_stop0 is None
+            else jnp.broadcast_to(kv_stop0, (b,)).astype(jnp.int32)
         )
+        return jnp.concatenate([
+            decode_attention_chunk(
+                q[:, o:o + CHUNK_MAX_SQ], k8, ks, v8, vs,
+                kv_start=kv_start, kv_stop0=stop0 + o, scale=scale,
+                interpret=interpret,
+            )
+            for o in range(0, s_q, CHUNK_MAX_SQ)
+        ], axis=1)
     if l_buf % LANES or dh % LANES:
         raise NotImplementedError(
             f"cache length {l_buf} and head dim {dh} must be multiples of "
@@ -540,23 +670,95 @@ def paged_block_kv(l_buf: int, h_kv: int, dh: int,
     return None
 
 
+def paged_fetch_mode() -> str:
+    """``MLCOMP_TPU_PAGED_FETCH``: how the paged kernels move a
+    block's pages from the HBM pool arrays into VMEM.
+
+    - ``double``: rolling DOUBLE BUFFER across grid steps — block
+      j+1's page DMAs are STARTED before block j's flash update runs,
+      so the next block's HBM traffic overlaps the current block's
+      arithmetic (two block-scratch slots, one DMA semaphore each;
+      only the row's first live block's fetch is exposed);
+    - ``rolled``: the PR-8 serial start-then-wait-per-page fetch — the
+      bisect/reference arm (identical bytes, zero overlap);
+    - ``auto`` (default): ``double`` on a real TPU, ``rolled`` under
+      interpret mode — emulated semaphores overlap nothing, they just
+      add interpreter work per block, so CPU runs keep the reference
+      schedule (the bit-equality tests pin both modes explicitly).
+
+    Both modes are bit-exact vs each other and vs the lax gather
+    reference: they move the same pages into the same block layout and
+    run the same ``_flash_block_update`` — only WHEN the copies fly
+    differs.  Read at trace time (an env flip needs a re-trace, like
+    MLCOMP_TPU_PAGED_ATTN)."""
+    mode = os.environ.get("MLCOMP_TPU_PAGED_FETCH", "auto")
+    if mode not in ("auto", "double", "rolled"):
+        raise ValueError(
+            f"MLCOMP_TPU_PAGED_FETCH must be auto/double/rolled, "
+            f"got {mode!r}"
+        )
+    if mode == "auto":
+        try:
+            mode = (
+                "double"
+                if jax.default_backend() in ("tpu", "axon") else "rolled"
+            )
+        except Exception:
+            mode = "rolled"
+    return mode
+
+
+def paged_fetch_cost_model(l_buf: int, h_kv: int, dh: int,
+                           page_tokens: int,
+                           window: Optional[int] = None,
+                           itemsize: int = 1,
+                           scale_bytes: int = 2) -> dict:
+    """Analytic per-row cost model for the two fetch modes (the
+    CPU-container stand-in for a real-TPU profile, next to the
+    engine's ``kv_bytes_moved_per_dispatch``): bytes are identical —
+    what differs is how many block-fetches sit on the critical path.
+    ``rolled`` serializes every live block's DMA before its compute
+    (exposed_block_fetches = live blocks); ``double`` exposes only the
+    first live block's fetch and overlaps the rest behind
+    ``_flash_block_update`` (exposed = 1).  Real-TPU tuning of the
+    overlap is the documented follow-up (this container is CPU-only).
+    """
+    blk = paged_block_kv(l_buf, h_kv, dh, page_tokens)
+    if blk is None:
+        return {"eligible": False}
+    win = l_buf if window is None else int(window)
+    live_blocks = max(1, -(-win // blk))
+    block_bytes = 2 * h_kv * blk * (dh * itemsize + scale_bytes)
+    return {
+        "eligible": True,
+        "block_kv": blk,
+        "pages_per_block": blk // page_tokens,
+        "live_blocks": live_blocks,
+        "block_fetch_bytes": block_bytes,
+        "fetch_bytes_per_row": block_bytes * live_blocks,
+        "exposed_block_fetches": {"rolled": live_blocks, "double": 1},
+    }
+
+
 def _fetch_block_pages(
     tbl_ref, b, j, lo, hi, sem,
     kq_hbm, ks_hbm, vq_hbm, vs_hbm,
     k_buf, ks_buf, v_buf, vs_buf,
     *, page_tokens: int, pages_per_block: int, null_page: int,
 ):
-    """DMA block ``j``'s pages from the HBM pool arrays into the VMEM
-    block scratch, table-driven.  Pages wholly outside [lo, hi) — and
-    NULL pages — are skipped: no copy issues, and the stale scratch
-    bytes land on columns the mask removes before the softmax.
+    """ROLLED fetch: DMA block ``j``'s pages from the HBM pool arrays
+    into the VMEM block scratch, table-driven, start-then-wait per
+    page — the PR-8 reference the double-buffered path A/Bs against.
+    Pages wholly outside [lo, hi) — and NULL pages — are skipped: no
+    copy issues, and the stale scratch bytes land on columns the mask
+    removes before the softmax.
 
     A ``fori_loop`` (one traced body) rather than a Python unroll:
     pages_per_block can run into the dozens at small page sizes, and
     an unrolled body that size multiplies COMPILE time per kernel —
     measured ~25% on the engine's CPU-interpret test matrix — for no
-    runtime difference (the copies are serial either way; overlapping
-    them is the roofline follow-up)."""
+    runtime difference in THIS mode (the copies are serial by
+    construction; ``double`` is the overlapped mode)."""
     T = page_tokens
 
     def body(p, _):
@@ -602,18 +804,168 @@ def _fetch_block_pages(
     jax.lax.fori_loop(0, pages_per_block, body, 0)
 
 
+def _page_copies(pid, p, bufs, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+                 *, page_tokens: int):
+    """The four async-copy descriptors landing physical page ``pid``
+    at block offset ``p`` in buffer set ``bufs`` = (k, ks, v, vs,
+    sem).  One builder shared by the START (prefetch) and WAIT
+    (consume) halves of the double buffer, so both sides describe the
+    SAME copies on the same semaphore."""
+    T = page_tokens
+    k_buf, ks_buf, v_buf, vs_buf, sem = bufs
+    return (
+        pltpu.make_async_copy(
+            kq_hbm.at[pid], k_buf.at[:, pl.ds(p * T, T), :], sem
+        ),
+        pltpu.make_async_copy(
+            vq_hbm.at[pid], v_buf.at[:, pl.ds(p * T, T), :], sem
+        ),
+        pltpu.make_async_copy(
+            ks_hbm.at[pid], ks_buf.at[:, :, pl.ds(p * T, T)], sem
+        ),
+        pltpu.make_async_copy(
+            vs_hbm.at[pid], vs_buf.at[:, :, pl.ds(p * T, T)], sem
+        ),
+    )
+
+
+def _start_block_pages(
+    tbl_ref, b, jb, lo, hi, bufs,
+    kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+    *, page_tokens: int, pages_per_block: int, null_page: int,
+):
+    """START block ``jb``'s live page DMAs into ``bufs`` — no waits:
+    the prefetch half of the rolling double buffer.  The skip
+    predicate (window overlap + non-NULL) is a pure function of the
+    prefetched scalars, so the wait half recomputes it EXACTLY and the
+    per-semaphore start/wait counts always balance."""
+    T = page_tokens
+
+    def body(p, _):
+        col = jb * pages_per_block + p
+        pid = tbl_ref[b, col]
+        t0 = col * T
+        use = (t0 < hi) & (t0 + T > lo) & (pid != null_page)
+
+        @pl.when(use)
+        def _start():
+            for cp in _page_copies(
+                pid, p, bufs, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+                page_tokens=T,
+            ):
+                cp.start()
+
+        return _
+
+    jax.lax.fori_loop(0, pages_per_block, body, 0)
+
+
+def _wait_block_pages(
+    tbl_ref, b, jb, lo, hi, bufs,
+    kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+    *, page_tokens: int, pages_per_block: int, null_page: int,
+):
+    """WAIT for the copies ``_start_block_pages`` issued for block
+    ``jb`` (reconstructed descriptors decrement the same per-buffer
+    semaphore), and zero the scale slices of skipped pages — the same
+    NaN-poisoning guard as the rolled fetch (see ``_blank`` there)."""
+    T = page_tokens
+    k_buf, ks_buf, v_buf, vs_buf, sem = bufs
+
+    def body(p, _):
+        col = jb * pages_per_block + p
+        pid = tbl_ref[b, col]
+        t0 = col * T
+        use = (t0 < hi) & (t0 + T > lo) & (pid != null_page)
+
+        @pl.when(use)
+        def _wait():
+            for cp in _page_copies(
+                pid, p, bufs, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+                page_tokens=T,
+            ):
+                cp.wait()
+
+        @pl.when(~use)
+        def _blank():
+            ks_buf[:, :, pl.ds(p * T, T)] = jnp.zeros(
+                (ks_buf.shape[0], 1, T), ks_buf.dtype
+            )
+            vs_buf[:, :, pl.ds(p * T, T)] = jnp.zeros(
+                (vs_buf.shape[0], 1, T), vs_buf.dtype
+            )
+
+        return _
+
+    jax.lax.fori_loop(0, pages_per_block, body, 0)
+
+
+def _db_fetch_step(
+    tbl_ref, b, j, nk, lo, hi, live_fn, compute,
+    bufs0, bufs1,
+    kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+    *, page_tokens: int, pages_per_block: int, null_page: int,
+):
+    """One grid step of the rolling double buffer, shared by the
+    single-token and multi-query paged kernels (they differ only in
+    their window/mask shapes):
+
+    - at the row's first step, prefetch block 0 into buffer 0;
+    - START block j+1's pages into buffer (j+1)%2 BEFORE touching
+      block j's data — those DMAs fly while this step's
+      ``_flash_block_update`` runs (the overlap this PR adds);
+    - WAIT block j's copies in buffer j%2, then ``compute`` on it.
+
+    Buffer parity is resolved with static ``pl.when`` branches (two
+    buffer SETS, not a dynamically-indexed scratch axis), so every
+    semaphore and scratch access is static.  Starts are gated by the
+    SAME live/use predicates as waits, so no copy is ever started
+    without its wait (an unbalanced semaphore would poison the next
+    block sharing the slot)."""
+    kw = dict(page_tokens=page_tokens, pages_per_block=pages_per_block,
+              null_page=null_page)
+    hbm = (kq_hbm, ks_hbm, vq_hbm, vs_hbm)
+    even = jax.lax.rem(j, 2) == 0
+
+    @pl.when((j == 0) & live_fn(0))
+    def _prefetch_first():
+        _start_block_pages(tbl_ref, b, 0, lo, hi, bufs0, *hbm, **kw)
+
+    nxt = (j + 1 < nk) & live_fn(j + 1)
+
+    @pl.when(nxt & even)           # j even -> block j+1 lands in bufs1
+    def _start_odd():
+        _start_block_pages(tbl_ref, b, j + 1, lo, hi, bufs1, *hbm, **kw)
+
+    @pl.when(nxt & ~even)
+    def _start_even():
+        _start_block_pages(tbl_ref, b, j + 1, lo, hi, bufs0, *hbm, **kw)
+
+    cur = live_fn(j)
+
+    @pl.when(cur & even)
+    def _consume_even():
+        _wait_block_pages(tbl_ref, b, j, lo, hi, bufs0, *hbm, **kw)
+        compute(bufs0)
+
+    @pl.when(cur & ~even)
+    def _consume_odd():
+        _wait_block_pages(tbl_ref, b, j, lo, hi, bufs1, *hbm, **kw)
+        compute(bufs1)
+
+
 def _paged_kernel(
     start_ref, stop_ref, tbl_ref,  # scalar prefetch
     q_ref, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
     o_ref,
-    k_buf, ks_buf, v_buf, vs_buf,
-    acc_ref, m_ref, l_ref, sem,
-    *, scale: float, block_kv: int, page_tokens: int,
-    pages_per_block: int, null_page: int,
+    *scratch,
+    scale: float, block_kv: int, page_tokens: int,
+    pages_per_block: int, null_page: int, fetch: str,
 ):
     b = pl.program_id(0)
     j = pl.program_id(1)
     nk = pl.num_programs(1)
+    acc_ref, m_ref, l_ref = scratch[-3:]
 
     @pl.when(j == 0)
     def _init():
@@ -623,27 +975,44 @@ def _paged_kernel(
 
     lo = start_ref[b]
     hi = stop_ref[b]
-    live = (j * block_kv < hi) & ((j + 1) * block_kv > lo)
+
+    def live_fn(jb):
+        return (jb * block_kv < hi) & ((jb + 1) * block_kv > lo)
 
     def mask_fn(shape):
         cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 2)
         return (cols >= lo) & (cols < hi)
 
-    @pl.when(live)
-    def _step():
-        _fetch_block_pages(
-            tbl_ref, b, j, lo, hi, sem,
-            kq_hbm, ks_hbm, vq_hbm, vs_hbm,
-            k_buf, ks_buf, v_buf, vs_buf,
-            page_tokens=page_tokens, pages_per_block=pages_per_block,
-            null_page=null_page,
-        )
+    def compute(bufs):
+        k_buf, ks_buf, v_buf, vs_buf, _sem = bufs
         q = q_ref[0]                               # (Hkv, Gp, dh)
         _flash_block_update(
             q, k_buf[:].astype(q.dtype), ks_buf[:],
             v_buf[:].astype(q.dtype), vs_buf[:],
             mask_fn, scale, acc_ref, m_ref, l_ref,
         )
+
+    if fetch == "double":
+        bufs0, bufs1 = scratch[0:5], scratch[5:10]
+        _db_fetch_step(
+            tbl_ref, b, j, nk, lo, hi, live_fn, compute, bufs0, bufs1,
+            kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+            page_tokens=page_tokens, pages_per_block=pages_per_block,
+            null_page=null_page,
+        )
+    else:
+        bufs = scratch[0:5]
+
+        @pl.when(live_fn(j))
+        def _step():
+            _fetch_block_pages(
+                tbl_ref, b, j, lo, hi, bufs[4],
+                kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+                bufs[0], bufs[1], bufs[2], bufs[3],
+                page_tokens=page_tokens,
+                pages_per_block=pages_per_block, null_page=null_page,
+            )
+            compute(bufs)
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -652,14 +1021,20 @@ def _paged_kernel(
 
 def _paged_call(
     kernel, q, kq_pages, ks_pages, vq_pages, vs_pages, table,
-    start, stop, interpret: bool,
+    start, stop, interpret: bool, fetch: Optional[str] = None,
 ):
     """Shared pallas_call plumbing for the two paged kernels: grid
     (B, nk) over dense-sized blocks, table prefetched as the third
     scalar, page arrays pinned in HBM (ANY), block scratch + online
-    state in VMEM."""
+    state in VMEM.  ``fetch`` picks the page-DMA schedule (default:
+    :func:`paged_fetch_mode`): ``double`` allocates TWO block-scratch
+    sets (+ one DMA semaphore each) and rolls the prefetch one block
+    ahead of compute; ``rolled`` keeps the single-buffered PR-8
+    reference."""
     from mlcomp_tpu.kvpool.allocator import NULL_PAGE
 
+    if fetch is None:
+        fetch = paged_fetch_mode()
     b = q.shape[0]
     _, h_kv, T, dh = kq_pages.shape
     mp = table.shape[1]
@@ -674,10 +1049,22 @@ def _paged_call(
         )
     nk = l_buf // blk
     sp = q.shape[2]
+    block_set = [
+        pltpu.VMEM((h_kv, blk, dh), kq_pages.dtype),
+        pltpu.VMEM((h_kv, 1, blk), ks_pages.dtype),
+        pltpu.VMEM((h_kv, blk, dh), vq_pages.dtype),
+        pltpu.VMEM((h_kv, 1, blk), vs_pages.dtype),
+        pltpu.SemaphoreType.DMA,
+    ]
+    scratch = block_set * (2 if fetch == "double" else 1) + [
+        pltpu.VMEM((h_kv, sp, dh), jnp.float32),
+        pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
+        pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
+    ]
     return pl.pallas_call(
         functools.partial(
             kernel, block_kv=blk, page_tokens=T,
-            pages_per_block=blk // T, null_page=NULL_PAGE,
+            pages_per_block=blk // T, null_page=NULL_PAGE, fetch=fetch,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
@@ -693,16 +1080,7 @@ def _paged_call(
             out_specs=pl.BlockSpec(
                 (1, h_kv, sp, dh), lambda b_, j, *_: (b_, 0, 0, 0)
             ),
-            scratch_shapes=[
-                pltpu.VMEM((h_kv, blk, dh), kq_pages.dtype),
-                pltpu.VMEM((h_kv, 1, blk), ks_pages.dtype),
-                pltpu.VMEM((h_kv, blk, dh), vq_pages.dtype),
-                pltpu.VMEM((h_kv, 1, blk), vs_pages.dtype),
-                pltpu.VMEM((h_kv, sp, dh), jnp.float32),
-                pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
-                pltpu.VMEM((h_kv, sp, LANES), jnp.float32),
-                pltpu.SemaphoreType.DMA,
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct((b, h_kv, sp, dh), q.dtype),
         interpret=interpret,
@@ -745,6 +1123,7 @@ def paged_decode_attention(
     kv_stop: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    fetch: Optional[str] = None,
 ) -> jax.Array:
     """:func:`decode_attention` reading the int8 KV cache THROUGH a
     page table: q (B, H, dh); kq/vq pages (P, Hkv, T, dh) int8; ks/vs
@@ -752,7 +1131,8 @@ def paged_decode_attention(
     page j to a physical page (MP * T must equal the leaf buffer
     length, lane-aligned like the dense kernel's).  Windows and output
     exactly as the dense kernel — bit-identical on the same cache
-    bytes (shared block partition + shared arithmetic)."""
+    bytes (shared block partition + shared arithmetic).  ``fetch``
+    overrides :func:`paged_fetch_mode` (the rolled-vs-double A/B)."""
     b, h, dh_q = q.shape
     h_kv, T, dh = _check_paged_operands(
         h, kq_pages, ks_pages, vq_pages, vs_pages, table
@@ -781,7 +1161,7 @@ def paged_decode_attention(
     out = _paged_call(
         functools.partial(_paged_kernel, scale=scale),
         qg, kq_pages, ks_pages, vq_pages, vs_pages,
-        table.astype(jnp.int32), start, stop, interpret,
+        table.astype(jnp.int32), start, stop, interpret, fetch=fetch,
     )
     return out[:, :, :rep].reshape(b, h, dh)
 
@@ -790,14 +1170,15 @@ def _paged_kernel_chunk(
     start_ref, stop0_ref, tbl_ref,  # scalar prefetch
     q_ref, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
     o_ref,
-    k_buf, ks_buf, v_buf, vs_buf,
-    acc_ref, m_ref, l_ref, sem,
-    *, scale: float, block_kv: int, page_tokens: int,
+    *scratch,
+    scale: float, block_kv: int, page_tokens: int,
     pages_per_block: int, null_page: int, rep: int, s_q: int,
+    fetch: str,
 ):
     b = pl.program_id(0)
     j = pl.program_id(1)
     nk = pl.num_programs(1)
+    acc_ref, m_ref, l_ref = scratch[-3:]
 
     @pl.when(j == 0)
     def _init():
@@ -808,7 +1189,9 @@ def _paged_kernel_chunk(
     lo = start_ref[b]
     stop0 = stop0_ref[b]
     hi_max = stop0 + (s_q - 1)
-    live = (j * block_kv < hi_max) & ((j + 1) * block_kv > lo)
+
+    def live_fn(jb):
+        return (jb * block_kv < hi_max) & ((jb + 1) * block_kv > lo)
 
     def mask_fn(shape):
         cols = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 2)
@@ -818,21 +1201,36 @@ def _paged_kernel_chunk(
         )
         return (cols >= lo) & (cols < stop0 + qrow)
 
-    @pl.when(live)
-    def _step():
-        _fetch_block_pages(
-            tbl_ref, b, j, lo, hi_max, sem,
-            kq_hbm, ks_hbm, vq_hbm, vs_hbm,
-            k_buf, ks_buf, v_buf, vs_buf,
-            page_tokens=page_tokens, pages_per_block=pages_per_block,
-            null_page=null_page,
-        )
+    def compute(bufs):
+        k_buf, ks_buf, v_buf, vs_buf, _sem = bufs
         q = q_ref[0]                               # (Hkv, Sp, dh)
         _flash_block_update(
             q, k_buf[:].astype(q.dtype), ks_buf[:],
             v_buf[:].astype(q.dtype), vs_buf[:],
             mask_fn, scale, acc_ref, m_ref, l_ref,
         )
+
+    if fetch == "double":
+        bufs0, bufs1 = scratch[0:5], scratch[5:10]
+        _db_fetch_step(
+            tbl_ref, b, j, nk, lo, hi_max, live_fn, compute,
+            bufs0, bufs1, kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+            page_tokens=page_tokens, pages_per_block=pages_per_block,
+            null_page=null_page,
+        )
+    else:
+        bufs = scratch[0:5]
+
+        @pl.when(live_fn(j))
+        def _step():
+            _fetch_block_pages(
+                tbl_ref, b, j, lo, hi_max, bufs[4],
+                kq_hbm, ks_hbm, vq_hbm, vs_hbm,
+                bufs[0], bufs[1], bufs[2], bufs[3],
+                page_tokens=page_tokens,
+                pages_per_block=pages_per_block, null_page=null_page,
+            )
+            compute(bufs)
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -850,6 +1248,7 @@ def paged_decode_attention_chunk(
     kv_stop0: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    fetch: Optional[str] = None,
 ) -> jax.Array:
     """:func:`decode_attention_chunk` through a page table: S chunk
     queries per row, ONE table-driven sweep of the paged cache (the
@@ -863,11 +1262,23 @@ def paged_decode_attention_chunk(
     if dh_q != dh:
         raise ValueError(f"q head dim {dh_q} != page head dim {dh}")
     if s_q > CHUNK_MAX_SQ:
-        raise NotImplementedError(
-            f"chunk width {s_q} > {CHUNK_MAX_SQ}: the multi-query kernel "
-            "is sized for verify/small-chunk shapes; wider chunks take "
-            "the XLA dequant path"
+        # query-tiled wide chunk, paged flavor: each tile streams the
+        # live window's pages through the table once (see the dense
+        # twin above for the exactness argument)
+        l_buf_w = table.shape[1] * T
+        stop0 = (
+            jnp.full((b,), l_buf_w - s_q + 1, jnp.int32)
+            if kv_stop0 is None
+            else jnp.broadcast_to(kv_stop0, (b,)).astype(jnp.int32)
         )
+        return jnp.concatenate([
+            paged_decode_attention_chunk(
+                q[:, o:o + CHUNK_MAX_SQ], kq_pages, ks_pages, vq_pages,
+                vs_pages, table, kv_start=kv_start, kv_stop0=stop0 + o,
+                scale=scale, interpret=interpret, fetch=fetch,
+            )
+            for o in range(0, s_q, CHUNK_MAX_SQ)
+        ], axis=1)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     l_buf = table.shape[1] * T
@@ -893,7 +1304,7 @@ def paged_decode_attention_chunk(
         functools.partial(_paged_kernel_chunk, scale=scale, rep=rep,
                           s_q=s_q),
         qg, kq_pages, ks_pages, vq_pages, vs_pages,
-        table.astype(jnp.int32), start, stop0, interpret,
+        table.astype(jnp.int32), start, stop0, interpret, fetch=fetch,
     )
     out = out[:, :, :rows].reshape(b, h_kv, s_q, rep, dh)
     return out.transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, dh)

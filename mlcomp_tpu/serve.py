@@ -200,7 +200,7 @@ class GenerationService:
         mesh=None,
         repetition_penalty: float = 1.0,
         batcher: str = "auto",
-        steps_per_dispatch: Optional[int] = None,
+        steps_per_dispatch: "Optional[int | str]" = None,
         prefill_chunk: int = 256,
         spec_k: int = 8,
         engine_spec_k: Optional[int] = None,
@@ -497,6 +497,15 @@ class GenerationService:
         if batcher == "continuous":
             from mlcomp_tpu.engine import DecodeEngine
 
+            # SERVICE default: adaptive dispatch depth — the drive
+            # loop picks K per boundary from the live queue-depth /
+            # occupancy signals (shallow queues small K for TTFT, deep
+            # queues large K for dispatch amortization).  An explicit
+            # --engine-steps-per-dispatch PINS K (the bisect override);
+            # spec engines never read the knob (the verify replaces
+            # the scan).
+            if steps_per_dispatch is None and engine_spec_k is None:
+                steps_per_dispatch = "adaptive"
             self.engine = DecodeEngine(
                 model, self.variables,
                 slots=self.batch_sizes[-1],
@@ -918,11 +927,15 @@ class GenerationService:
                 # warmup compiles, so the cap matters on slow backends
                 f.result(timeout=self.request_timeout_s)
             # prefix-cache capture/insert programs (cheap: no model
-            # trace) and the fused prefill+decode dispatches (real
-            # compiles — one per chunk width) — without this the first
-            # real request / first overlapped admission pays their
+            # trace), the K LADDER's plain dispatch programs (adaptive
+            # engines: one real compile per rung, so a controller
+            # switch mid-serving is a dict lookup), and the fused
+            # prefill+decode dispatches (real compiles — one per chunk
+            # width per rung) — without this the first real request /
+            # first overlapped admission / first K switch pays their
             # compile on the engine loop thread mid-serving
             return (len(futs) + self.engine.warm_prefix_fns()
+                    + self.engine.warm_dispatch_fns()
                     + self.engine.warm_fused_fns())
         if self.batcher == "speculative":
             import jax.numpy as jnp

@@ -449,7 +449,7 @@ def test_engine_close_under_load_and_wedged_abandon():
         release.wait(timeout=30)
         return real(*a, **kw)
 
-    eng2._fns["dispatch"] = wedged
+    eng2._fns[("dispatch", eng2.steps_per_dispatch)] = wedged
     f_active = eng2.submit([3, 14, 15, 9, 2], 4)
     _t.sleep(0.3)  # let the thread enter the wedged dispatch
     f_queued = eng2.submit([1, 2], 2)

@@ -275,7 +275,11 @@ def test_staged_flag_plumbing_and_metrics():
     )
     try:
         assert svc.engine.fused_admission is True
-        assert svc.engine.warm_fused_fns() == 1   # one chunk width
-        assert ("fused_dispatch", 16) in svc.engine._fns
+        # one chunk width x every ladder rung (the serve default is
+        # adaptive K, so the fused family precompiles per rung)
+        ladder = svc.engine.k_ladder
+        assert svc.engine.warm_fused_fns() == len(ladder)
+        for k in ladder:
+            assert ("fused_dispatch", 16, k) in svc.engine._fns
     finally:
         svc.close()

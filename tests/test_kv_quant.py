@@ -143,18 +143,37 @@ def test_chunk_kernel_agrees_with_single_token_kernel():
     )
 
 
-def test_chunk_kernel_rejects_wide_chunks():
+def test_chunk_kernel_tiles_wide_chunks():
+    """Chunks wider than one kernel tile no longer raise (the pre-
+    ISSUE-13 NotImplementedError): they run as query-TILED sweeps —
+    shape-correct, and each tile bit-identical to calling the kernel
+    on that tile with the position-offset stop."""
     from mlcomp_tpu.ops.pallas.decode_attention import (
         CHUNK_MAX_SQ,
         decode_attention_chunk,
     )
 
     b, h, dh, l_buf = 1, 4, 128, 256
-    q = jnp.zeros((b, CHUNK_MAX_SQ + 1, h, dh))
-    k8 = jnp.zeros((b, h, l_buf, dh), jnp.int8)
-    sc = jnp.zeros((b, h, 1, l_buf))
-    with pytest.raises(NotImplementedError, match="chunk width"):
-        decode_attention_chunk(q, k8, sc, k8, sc)
+    s = CHUNK_MAX_SQ + 1
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, s, h, dh)), jnp.float32)
+    k8 = jnp.asarray(rng.integers(-127, 128, (b, h, l_buf, dh)), jnp.int8)
+    sc = jnp.asarray(rng.random((b, h, 1, l_buf)), jnp.float32)
+    stop0 = jnp.asarray([l_buf - s + 1], jnp.int32)
+    wide = decode_attention_chunk(
+        q, k8, sc, k8, sc, kv_stop0=stop0, interpret=True
+    )
+    assert wide.shape == (b, s, h, dh)
+    head = decode_attention_chunk(
+        q[:, :CHUNK_MAX_SQ], k8, sc, k8, sc, kv_stop0=stop0,
+        interpret=True,
+    )
+    tail = decode_attention_chunk(
+        q[:, CHUNK_MAX_SQ:], k8, sc, k8, sc,
+        kv_stop0=stop0 + CHUNK_MAX_SQ, interpret=True,
+    )
+    assert (np.asarray(head) == np.asarray(wide)[:, :CHUNK_MAX_SQ]).all()
+    assert (np.asarray(tail) == np.asarray(wide)[:, CHUNK_MAX_SQ:]).all()
 
 
 def test_decode_kernel_rejects_bad_scale_shape():

@@ -39,7 +39,14 @@ watchdog armed):
   ``no_free_pages``) at the dispatch boundary — never a hang, never a
   fleet error — its freed pages must unblock the neighbour starved in
   the same tick, the surviving stream's tokens must be bit-identical
-  to a solo run, and at quiesce the pool holds zero leaked pages.
+  to a solo run, and at quiesce the pool holds zero leaked pages;
+- **adaptive-K switch mid-stream** (scenario 9, adaptive dispatch
+  depth — the serve default): a concurrent burst pushes the ladder
+  controller up (and the quiesce snap brings it back down) while a
+  fault-stretched stream decodes — the survivor's tokens must be
+  bit-identical to a solo run (the K-invariant RNG/scan contract),
+  the controller must have actually switched, and the fleet drains
+  clean.
 
 The daemon runs the PAGED device KV layout (``kv_layout="paged"``,
 mlcomp_tpu/kvpool), so every scenario above also exercises the page
@@ -412,6 +419,7 @@ def run() -> dict:
         out["page_pool_exhaustion"] = _scenario_page_exhaustion()
         out["lazy_page_exhaustion"] = _scenario_lazy_page_exhaustion()
         out["replica_kill"] = _scenario_replica_kill()
+        out["adaptive_k_switch"] = _scenario_adaptive_k_switch()
         return out
     finally:
         faults.disarm_all()
@@ -601,6 +609,66 @@ def _scenario_lazy_page_exhaustion() -> dict:
         }
     finally:
         eng.close()
+
+
+def _scenario_adaptive_k_switch() -> dict:
+    """Scenario 9 — adaptive dispatch depth: controller K switches
+    with a stream in flight must move time, never tokens.  The daemon
+    runs the serve default (``steps_per_dispatch="adaptive"``).  A
+    solo stream's tokens are the baseline; the chaos run re-opens the
+    same stream with a slow-resolve fault stretching its dispatches,
+    then fires a concurrent burst deep enough to push the controller
+    up the ladder while the stream decodes (and back down at the
+    quiesce snap).  The survivor's streamed tokens must be
+    bit-identical to the solo run, the controller must have actually
+    switched inside the window, and the fleet drains clean."""
+    d = _Daemon()
+    try:
+        eng = d.svc.engine
+        assert eng.adaptive_k, "serve default must be adaptive"
+        base_prompt = [9, 10, 11, 12, 13, 14, 15, 16, 17]
+        p = base_prompt + [4]
+        toks_solo, _ = d.read_stream(d.open_stream(p, 8))
+        d.svc.prefix_cache.flush()
+        changes0 = eng.stats()["dispatch_k_changes"]
+        # stretch the survivor's dispatches so the burst's controller
+        # climb definitely lands while it is mid-stream (scenario 0
+        # proved the fault itself is latency-only)
+        faults.arm("engine.resolve", flavor="sleep", times=8,
+                   seconds=0.1)
+        resp = d.open_stream(p, 8)
+        # distinct in-vocab tails (vocab_size=64; out-of-range ids
+        # would clamp and collapse the burst into identical prompts)
+        burst = [
+            threading.Thread(
+                target=d.generate, args=(base_prompt + [20 + i],),
+                daemon=True,
+            )
+            for i in range(8)
+        ]
+        for th in burst:
+            th.start()
+        toks, _ = d.read_stream(resp)
+        for th in burst:
+            th.join(timeout=120)
+        faults.disarm_all()
+        assert toks == toks_solo, (toks, toks_solo)
+        st = eng.stats()
+        k_changes = st["dispatch_k_changes"] - changes0
+        assert k_changes > 0, (
+            "controller never switched K under the burst"
+        )
+        assert st["steps_per_dispatch"] in eng.k_ladder, st
+        d.assert_drained("adaptive_k_switch")
+        return {
+            "survivor_exact": True,
+            "k_changes": int(k_changes),
+            "final_k": st["steps_per_dispatch"],
+            "ladder": list(eng.k_ladder),
+        }
+    finally:
+        faults.disarm_all()
+        d.close()
 
 
 def _scenario_replica_kill() -> dict:

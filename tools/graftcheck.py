@@ -132,6 +132,7 @@ LOCK_FILES = (
 CONDITIONAL_METRICS = {
     # spec engines only (obs_check's daemon has no --engine-spec-k)
     "mlcomp_engine_spec_net_gain",
+    "mlcomp_engine_spec_ineffective",
     # window/speculative batchers only (the daemon runs continuous)
     "mlcomp_service_requests_total",
     "mlcomp_service_queue_depth",

@@ -85,6 +85,8 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_engine_pipeline_hidden_ms_total",
     "mlcomp_engine_pipeline_wait_ms_total",
     "mlcomp_engine_pipeline_overlap_efficiency",
+    "mlcomp_engine_dispatch_k",
+    "mlcomp_engine_dispatch_k_changes_total",
     "mlcomp_engine_trace_events_dropped_total",
     "mlcomp_engine_ttft_ms",
     "mlcomp_engine_per_token_ms",
@@ -395,6 +397,39 @@ def run(n_requests: int = 3) -> dict:
         assert s2["mlcomp_engine_kv_bytes_moved_per_dispatch"][""] >= 0
         assert s2["mlcomp_engine_kv_pages_lazy_allocated_total"][""] > 0
         assert s2["mlcomp_engine_kv_decode_page_failures_total"][""] == 0
+
+        # ---- adaptive dispatch depth: the daemon runs the serve
+        # default (steps_per_dispatch="adaptive"), so the dispatch_k
+        # gauge must sit on the ladder — and a CONCURRENT burst (queue
+        # deeper than the slot pool) must move the controller off the
+        # quiesce floor: the changes counter advances and the gauge
+        # still reads a ladder rung afterwards
+        assert svc.engine.adaptive_k, "serve default should be adaptive"
+        ladder = set(svc.engine.k_ladder)
+        assert s2["mlcomp_engine_dispatch_k"][""] in ladder, (
+            s2["mlcomp_engine_dispatch_k"], ladder
+        )
+        changes0 = s2["mlcomp_engine_dispatch_k_changes_total"][""]
+        # distinct in-vocab tails (vocab_size=64: an out-of-range id
+        # would clamp in the embedding gather and collapse the burst
+        # into 8 copies of one prompt)
+        burst_threads = [
+            threading.Thread(
+                target=lambda i=i: generate(shared + [40 + i],
+                                            max_new=8),
+                daemon=True,
+            )
+            for i in range(8)
+        ]
+        for th2 in burst_threads:
+            th2.start()
+        for th2 in burst_threads:
+            th2.join(timeout=300)
+        s2b, t2b = parse_exposition(get("/metrics").decode())
+        assert s2b["mlcomp_engine_dispatch_k"][""] in ladder
+        assert (
+            s2b["mlcomp_engine_dispatch_k_changes_total"][""] > changes0
+        ), "adaptive-K gauge never moved under the burst"
 
         trace = json.loads(get("/trace?last_ms=600000"))
         evs = trace["traceEvents"]
