@@ -172,6 +172,7 @@ def bench_resnet() -> None:
     )
     tx = create_optimizer({"name": "sgd", "lr": 0.1, "momentum": 0.9})
     state = TrainState.create(model.apply, params, tx, model_state)
+    # graftcheck: ignore[donation-sharding] -- construction-time placement BEFORE the donating step loop; every donation rebinds state, so the chain never resharded mid-flight
     state = jax.device_put(state, replicated(mesh))
 
     sharding = batch_sharding(mesh)

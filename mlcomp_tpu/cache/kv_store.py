@@ -45,6 +45,22 @@ SLOT_AXES = {
     "cached_value_scale": 3,
 }
 
+# leaf name -> axis holding the KV-head dimension — the axis sharded
+# over the tp mesh axis when the engine serves sharded (the Megatron
+# K/V projections are head-sharded, so head-sharded cache bytes is
+# what XLA propagation picks; the paged layout pins it EXPLICITLY on
+# its page arrays so donation keeps a stable sharding).  Page arrays
+# keep the dense axis order minus the batch axis plus a leading page
+# axis, so the index is the same in both layouts.
+HEAD_AXES = {
+    "cached_key": 2,
+    "cached_value": 2,
+    "cached_key_q": 1,
+    "cached_value_q": 1,
+    "cached_key_scale": 1,
+    "cached_value_scale": 1,
+}
+
 
 def _leaf_name(path) -> str:
     key = path[-1]

@@ -350,6 +350,25 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             print(f"error: --mesh expects AXIS=N[,AXIS=N...], got"
                   f" {args.mesh!r}", file=sys.stderr)
             return 2
+    dist = None
+    if args.distributed:
+        # multi-host serve gang: connect this process to the
+        # jax.distributed runtime FIRST (device discovery must see the
+        # whole slice), then open the boundary side channel.  Every
+        # process runs the identical command line; process 0 fronts
+        # the gang, the rest follow (ready:false).
+        if not mesh_cfg:
+            print("error: --distributed needs --mesh (the gang runs "
+                  "one SPMD program over the global device mesh)",
+                  file=sys.stderr)
+            return 2
+        from mlcomp_tpu.parallel.distributed import (
+            BoundaryChannel,
+            init_distributed,
+        )
+
+        init_distributed()
+        dist = BoundaryChannel(port=args.sync_port)
     slo_config = None
     if args.slo_config:
         if not args.metrics_history_interval:
@@ -409,6 +428,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         max_slots=args.max_slots,
         metrics_history_interval=args.metrics_history_interval,
         slo_config=slo_config,
+        dist=dist,
     )
     if args.warmup:
         n = service.warmup()
@@ -746,7 +766,32 @@ def main(argv=None) -> int:
         " 'tp=4' on 8 chips gives dp=2 tp=4), and every --batch-sizes"
         " entry must divide dp*fsdp — pass 'dp=1,tp=8' to keep small"
         " batches servable.  --quantize kernel and --kv-quant compose"
-        " with tp/dp meshes (shard_map kernel islands); fsdp does not",
+        " with tp/dp meshes (shard_map kernel islands); fsdp does not."
+        " The continuous engine's dispatch pipeline (depth 2) and the"
+        " paged KV layout compose with the mesh too; speculative"
+        " dispatch and --prefix-cache remain single-chip",
+    )
+    sv.add_argument(
+        "--distributed", action="store_true",
+        help="multi-HOST serving: connect to the jax.distributed"
+        " runtime (MLCOMP_TPU_COORDINATOR / _NUM_PROCESSES /"
+        " _PROCESS_ID; under TPU auto-discovery still set"
+        " MLCOMP_TPU_COORDINATOR — followers dial that host for the"
+        " boundary side channel) and run one SPMD serve"
+        " gang over the global --mesh.  Process 0 owns the HTTP front"
+        " door and submit queue and broadcasts per-boundary"
+        " admission/retire decisions over a TCP side channel"
+        " (--sync-port) so every process executes the identical"
+        " dispatch sequence; the other processes answer /healthz as"
+        " ready:false followers (route traffic at the coordinator)."
+        " Every process runs the SAME command line (same --mesh, same"
+        " knobs, same seed)",
+    )
+    sv.add_argument(
+        "--sync-port", type=int, default=None,
+        help="--distributed boundary-channel TCP port (default:"
+        " MLCOMP_TPU_SYNC_PORT, else the jax.distributed coordinator"
+        " port + 1)",
     )
     sv.add_argument(
         "--batcher", default="auto",
@@ -798,8 +843,9 @@ def main(argv=None) -> int:
         " outputs are bit-identical, only slower).  Admissions ride"
         " the in-flight dispatches (fused prefill+decode); only the"
         " final insert drains the pipeline, so joins cost one insert"
-        " at any depth.  Single-chip for now: an explicit depth > 1"
-        " with --mesh is rejected rather than silently degrading",
+        " at any depth.  Composes with --mesh: SPMD dispatches chain"
+        " the donated sharded carry on the device stream exactly like"
+        " single-chip (depth 2 is the default there too)",
     )
     sv.add_argument(
         "--engine-staged-admission", action="store_true",
@@ -840,7 +886,10 @@ def main(argv=None) -> int:
         " scales elastically up to --max-slots, and same-placement"
         " shared prompt prefixes map the same physical pages"
         " copy-on-write.  Outputs are bit-identical to 'dense' (the"
-        " default and the bisect mode); single-chip for now",
+        " default and the bisect mode).  Composes with --mesh: page"
+        " arrays shard over tp at the kv-head axis, page tables"
+        " replicate (MLCOMP_TPU_PAGED_ATTN=lax is the sharded"
+        " reference/bisect path)",
     )
     sv.add_argument(
         "--kv-page-tokens", type=int, default=None,

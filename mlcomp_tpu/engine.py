@@ -85,16 +85,32 @@ fused trace embeds the SAME dispatch body (same scan order, same RNG
 stream — chunks consume no RNG), and ``fused_admission=False`` forces
 the staged path for bisection (``--engine-staged-admission``).
 
-Mesh composition (round 5, r4 verdict missing #2): pass ``mesh`` and
-the engine's prefill/insert/decode programs run as SPMD programs over
-it — weights arrive sharded (Megatron tp layout from the service
-loader), the per-slot KV cache shards by XLA propagation from the
-tp-sharded K/V projections, and the Pallas int8 paths (quant_kernel,
-kv_quant) run inside the same shard_map islands the window batcher
-certified (ops/quant.sharded_quant_matmul,
+Mesh composition (round 5, r4 verdict missing #2; first-class since
+the sharded-serving PR): pass ``mesh`` and the engine's
+prefill/insert/decode programs run as SPMD programs over it — weights
+arrive sharded (Megatron tp layout from the service loader), the
+per-slot KV cache shards by XLA propagation from the tp-sharded K/V
+projections, and the Pallas int8 paths (quant_kernel, kv_quant) run
+inside the same shard_map islands the window batcher certified
+(ops/quant.sharded_quant_matmul,
 decode_attention.sharded_decode_attention — they read the process
 mesh, which ``serve.load_service`` installs).  The host drives the
-same numpy knob rows; under SPMD they replicate.
+same numpy knob rows; under SPMD they replicate.  The sharded path is
+now a PEER of the single-device one: the dispatch pipeline runs at
+depth 2 by default under a mesh too (the donated carry chains on the
+device stream with its shardings preserved — explicit carries pin
+them with sharding constraints, so donation aliases buffers instead
+of resharding), the paged KV layout serves sharded (page arrays
+shard over tp at the kv-head axis, tables and the allocator's host
+mirror replicate; the kv8 family routes through the lax sandwich
+over the mesh-aware dense core until the paged kernels grow shard_map
+islands — the named follow-up), and a multi-host gang serves through
+``serve --distributed``: process 0 owns the HTTP front door and
+submit queue and broadcasts per-boundary admission/retire/K decisions
+over a TCP side channel (``parallel/distributed.BoundaryChannel``) so
+every process executes the identical dispatch sequence.  Speculative
+dispatch and the host prefix cache remain single-chip (rejected with
+messages naming the follow-up).
 
 Resilience layer (this PR): failure behavior is defined, not
 emergent.  Every request may carry a deadline and a cancel handle
@@ -165,6 +181,16 @@ class EngineStalled(RuntimeError):
     error."""
 
     status = "engine_stalled"
+
+
+class NotCoordinator(RuntimeError):
+    """This process is a FOLLOWER in a distributed serve gang: it
+    executes the coordinator's broadcast dispatch sequence and owns no
+    submit queue.  Send traffic to the coordinator (process 0) — its
+    ``/healthz`` answers ``ready: true``; followers answer false so
+    the fleet router never targets them.  HTTP maps this to 503."""
+
+    status = "not_coordinator"
 
 
 class ProfileBusy(RuntimeError):
@@ -293,6 +319,7 @@ class DecodeEngine:
         kv_pages: Optional[int] = None,
         max_slots: Optional[int] = None,
         k_ladder: Optional[Sequence[int]] = None,
+        dist=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -397,27 +424,35 @@ class DecodeEngine:
             True if fused_admission is None else bool(fused_admission)
         )
         self.mesh = mesh
+        # multi-host serve gang (parallel/distributed.BoundaryChannel):
+        # process 0 (the coordinator) owns the submit queue and
+        # broadcasts per-boundary admission/retire/K decisions; every
+        # other process replays them, so the whole gang executes the
+        # IDENTICAL dispatch sequence over the global mesh.  The
+        # broadcast is plain TCP (no device collectives), so it never
+        # interleaves with the SPMD programs it sequences.
+        self._dist = dist
+        if dist is not None and mesh is None:
+            raise ValueError(
+                "distributed serving (dist=...) needs a mesh: the gang "
+                "runs one SPMD program over the global device mesh"
+            )
         # in-flight dispatch pipeline depth D: the loop issues dispatch
         # N+1 with the donated carry BEFORE blocking on dispatch N's
         # packed outputs, hiding the host's dispatch+unpack cost behind
-        # device compute.  None resolves to 2 (double buffering) —
-        # except under a mesh, where SPMD dispatch is not pipelined yet
-        # and the default falls back to the synchronous loop.  An
-        # EXPLICIT depth > 1 with a mesh is rejected rather than
-        # silently degrading (the satellite contract: knobs the
-        # pipeline can't serve yet fail loudly).
+        # device compute.  None resolves to 2 (double buffering) — mesh
+        # or not: under SPMD the donated carry chains on the device
+        # stream exactly like single-chip (the per-dispatch host tunnel
+        # cost the pipeline hides is, if anything, LARGER multi-chip),
+        # and the carry keeps its shardings through the chain (the
+        # dispatch programs pin them with sharding constraints where
+        # they are explicit).  Depth 1 stays the debug/bisect mode.
         if pipeline_depth is None:
-            pipeline_depth = 1 if mesh is not None else 2
+            pipeline_depth = 2
         self.pipeline_depth = int(pipeline_depth)
         if self.pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {pipeline_depth}"
-            )
-        if self.pipeline_depth > 1 and mesh is not None:
-            raise ValueError(
-                "the dispatch pipeline is single-chip for now (SPMD "
-                "dispatch under a mesh is not pipelined); drop "
-                "pipeline_depth (or pass 1) or the mesh"
             )
         # speculative dispatch (round 5, opt-in): each dispatch samples
         # tok0 per row, drafts spec_k continuations by DEVICE-side
@@ -439,8 +474,9 @@ class DecodeEngine:
             if mesh is not None:
                 raise ValueError(
                     "speculative dispatch is single-chip for now (the "
-                    "multi-query kernel has no sharded wrapper); drop "
-                    "spec_k or the mesh"
+                    "multi-query verify kernel has no sharded wrapper; "
+                    "a sharded drafter is the sharded-serving PR's "
+                    "named follow-up); drop spec_k or the mesh"
                 )
             if self.quant_kernel:
                 # r5 verdict weak #3: the fat-block cliff lived only in
@@ -468,8 +504,11 @@ class DecodeEngine:
         if prefix_cache is not None and mesh is not None:
             raise ValueError(
                 "the prefix KV cache is single-chip for now (host-side "
-                "row inserts don't compose with a sharded cache); drop "
-                "prefix_cache or the mesh"
+                "row inserts don't compose with a sharded cache; "
+                "sharding the capture/assemble tier is the "
+                "sharded-serving PR's named follow-up — the device "
+                "prefix-page REGISTRY already serves sharded paged "
+                "engines); drop prefix_cache or the mesh"
             )
         if prefix_cache is not None:
             # hits are chunk-granular: a bucket that prefills as ONE
@@ -540,12 +579,6 @@ class DecodeEngine:
                     "kv_layout='paged'"
                 )
         else:
-            if mesh is not None:
-                raise ValueError(
-                    "the paged KV layout is single-chip for now (page "
-                    "gather/scatter has no sharded wrapper); drop "
-                    "kv_layout='paged' or the mesh"
-                )
             from mlcomp_tpu.kvpool import (
                 RESERVED_PAGES,
                 PagedLayout,
@@ -625,6 +658,14 @@ class DecodeEngine:
                     "MLCOMP_TPU_PAGED_ATTN must be auto/pallas/lax, got "
                     f"{self._paged_attn!r}"
                 )
+            if mesh is not None and self._paged_attn == "pallas":
+                raise ValueError(
+                    "MLCOMP_TPU_PAGED_ATTN=pallas does not compose "
+                    "with a mesh yet (the paged attention kernels have "
+                    "no shard_map islands — the sharded-serving PR's "
+                    "named follow-up); use auto (the sharded fused/"
+                    "sandwich routes) or lax (the reference sandwich)"
+                )
             # gather IMPLEMENTATION (the lax sandwich's dense-view
             # gather, the registry's row-span fetches, and the fused
             # path's per-layer fallback gathers — the non-quant family
@@ -636,6 +677,21 @@ class DecodeEngine:
             self._page_gather_impl = os.environ.get(
                 "MLCOMP_TPU_PAGE_GATHER", "auto"
             )
+            if mesh is not None:
+                # the Pallas scalar-prefetch gather is a bare
+                # pallas_call (no shard_map island yet — the same named
+                # follow-up as the paged kernels): under a mesh "auto"
+                # resolves to the jnp.take gather, which XLA partitions
+                # with the rest of the SPMD program; forcing pallas is
+                # rejected loudly rather than mis-partitioned silently
+                if self._page_gather_impl == "pallas":
+                    raise ValueError(
+                        "MLCOMP_TPU_PAGE_GATHER=pallas does not compose "
+                        "with a mesh (no shard_map island yet — the "
+                        "sharded-serving PR's named follow-up); use "
+                        "auto or lax"
+                    )
+                self._page_gather_impl = "lax"
             # does the fused data path run the paged ATTENTION KERNELS
             # (kv8 family whose buffer keeps the dense block partition
             # in whole pages), or per-layer gather fallbacks?  Decides
@@ -654,6 +710,22 @@ class DecodeEngine:
                 ) is not None
                 for s in quant_specs
             )
+            if mesh is not None and quant_specs:
+                # SHARDED paged serving, kv8 family: the fused path's
+                # attention is the paged Pallas kernels (or bare dense
+                # kernels on gathered bytes) — neither has a shard_map
+                # island yet, so "auto" resolves to the LAX SANDWICH:
+                # gather the dense view through the (replicated) table,
+                # run the UNCHANGED dense core — whose int8 attention
+                # already runs sharded_decode_attention islands under
+                # the mesh — and scatter back.  Bit-identical to dense
+                # by the same construction as single-chip; the fused
+                # sharded kernels are the named follow-up.  The f32
+                # family keeps the fused path (append_rows scatter +
+                # per-layer take gathers are plain XLA ops the SPMD
+                # partitioner handles).
+                self._paged_attn = "lax"
+                self._kv_fused_kernels = False
 
         # weight prep mirrors generate(): entry-dequant everything the
         # kernel won't consume, fold the rest — ONCE, outside any step
@@ -678,6 +750,30 @@ class DecodeEngine:
             # ids, no bucket pads): the n-gram draft's source
             self.t_ids = self.prompt_buckets[-1] + self.max_new_cap
         self._seed = int(seed)
+        # the jitted-program pool — built before the first carry (the
+        # sharded fresh-dstate initializer is itself a pooled program)
+        self._fns: Dict[Any, Any] = {}
+        # multi-process gang: host->device uploads must be REPLICATED
+        # global arrays (every process holds identical bytes — the
+        # boundary broadcast guarantees it), and the packed dispatch
+        # output must come back replicated so np.asarray can read it
+        # on every host
+        self._multiproc = (
+            dist is not None and dist.num_processes > 1
+        )
+        # explicit carry shardings (donation must PRESERVE shardings —
+        # the dispatch chain re-pins them with sharding constraints):
+        # the NEW sharded paths get them explicitly — paged page
+        # arrays shard over tp at the kv-head axis, tables/bookkeeping
+        # replicate — while the certified single-process dense-mesh
+        # path keeps XLA propagation (same programs as the MULTICHIP
+        # dryruns).  Multi-process engines need them for BOTH layouts:
+        # the fresh carry must be born as global arrays.
+        self._carry_shardings = None
+        if mesh is not None and (
+            self._layout is not None or dist is not None
+        ):
+            self._carry_shardings = self._build_carry_shardings()
         self._dstate = self._fresh_dstate()  # guarded_by: loop
         self._host: List[Optional[_Slot]] = (  # guarded_by: loop [writes]
             [None] * self.slots
@@ -838,7 +934,6 @@ class DecodeEngine:
         )
         self._hbm_gbps = float(os.environ.get("MLCOMP_TPU_HBM_GBPS", "819"))
         self.step_count = 0
-        self._fns: Dict[Any, Any] = {}
         # (chunk width, K) pairs whose fused program has COMPILED AND
         # RUN once (warmup or first-use warming) — tracked separately
         # from _fns because building the jit wrapper is not compiling
@@ -874,6 +969,90 @@ class DecodeEngine:
             )
             self._watchdog.start()
 
+    @property
+    def is_coordinator(self) -> bool:
+        """True for single-host engines and for process 0 of a
+        distributed serve gang — the process that owns the submit
+        queue and broadcasts boundary decisions."""
+        return self._dist is None or self._dist.is_coordinator
+
+    def _dev(self, x, dtype=None):
+        """Host->device upload, multi-process safe.  Single process:
+        a plain ``jnp.asarray``.  In a distributed gang every process
+        calls this with IDENTICAL bytes (the boundary broadcast is
+        what guarantees it), and the upload must be a fully-REPLICATED
+        global array or the SPMD programs reject the host-local
+        input."""
+        arr = np.asarray(x) if dtype is None else np.asarray(x, dtype)
+        if not self._multiproc:
+            return self._jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return self._jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, PartitionSpec()), arr
+        )
+
+    def _build_carry_shardings(self):
+        """NamedSharding pytree matching ``_fresh_dstate``'s structure:
+        KV bytes shard over the ``tp`` mesh axis at the kv-head axis
+        (``cache/kv_store.HEAD_AXES``) when the head count divides,
+        page tables and every bookkeeping row replicate.  The fresh
+        carry is BORN with these shardings (jitted init with
+        out_shardings) and every carry program re-pins them with a
+        sharding constraint, so the donated chain reuses buffers
+        instead of resharding — donation vectors must preserve
+        shardings (graftcheck's ``donation-sharding`` rule is the
+        static half of that contract)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from mlcomp_tpu.cache.kv_store import HEAD_AXES, _leaf_name
+        from mlcomp_tpu.models.generation import init_cache
+
+        jax, mesh = self._jax, self.mesh
+        tp = int(mesh.shape.get("tp", 1))
+        rep = NamedSharding(mesh, P())
+
+        def head_sharded(name: str, shape) -> Any:
+            ax = HEAD_AXES.get(name)
+            if ax is None or tp <= 1 or shape[ax] % tp:
+                return rep
+            parts: List[Any] = [None] * len(shape)
+            parts[ax] = "tp"
+            return NamedSharding(mesh, P(*parts))
+
+        ns = self.slots
+        sh: Dict[str, Any] = {}
+        if self._layout is not None:
+            # page arrays keep the dense axis order (page axis replaces
+            # batch), so the dense head axis index carries over
+            sh["pages"] = [
+                head_sharded(s.keystr.rsplit("/", 1)[-1], s.shape)
+                for s in self._layout.kv_specs
+            ]
+            sh["table"] = rep
+            sh["cache_scalars"] = [
+                rep for s in self._layout.leaves if s.slot_axis is None
+            ]
+        else:
+            cache_abs = jax.eval_shape(
+                lambda: init_cache(self.model, ns, self.l_buf)
+            )
+            sh["cache"] = jax.tree_util.tree_map_with_path(
+                lambda path, leaf: head_sharded(
+                    _leaf_name(path), leaf.shape
+                ),
+                cache_abs,
+            )
+        for key in ("last_logits", "presence", "cursors", "kv_start",
+                    "positions", "active", "remaining", "eos", "t",
+                    "k", "p", "rp", "rng", "rseed"):
+            sh[key] = rep
+        if self.spec_k is not None:  # unreachable under a mesh; shaped
+            sh["ids"] = rep          # anyway so the trees always match
+            sh["ids_len"] = rep
+        return sh
+
     def _fresh_dstate(self) -> Dict[str, Any]:
         """ALL decode state lives on device and is carried (donated)
         through the dispatch/insert programs: a steady-state dispatch
@@ -886,7 +1065,22 @@ class DecodeEngine:
         purely for bookkeeping (futures, streams, emitted tokens).
         Factored out of __init__ so a watchdog restart can rebuild the
         carry from scratch (a crashed loop may have died mid-donation,
-        leaving the old pytree invalid)."""
+        leaving the old pytree invalid).
+
+        With explicit carry shardings (sharded paged / distributed
+        engines) the carry is built INSIDE a jitted initializer with
+        ``out_shardings`` — born sharded, and in a multi-process gang
+        born as global arrays (a host-local ``jnp.zeros`` cannot feed
+        a global-mesh program)."""
+        if self._carry_shardings is None:
+            return self._dstate_build()
+        if "fresh_dstate" not in self._fns:
+            self._fns["fresh_dstate"] = self._jax.jit(
+                self._dstate_build, out_shardings=self._carry_shardings
+            )
+        return self._fns["fresh_dstate"]()
+
+    def _dstate_build(self) -> Dict[str, Any]:
         jax, jnp = self._jax, self._jnp
         from mlcomp_tpu.models.generation import init_cache
 
@@ -970,6 +1164,11 @@ class DecodeEngine:
                 f"{self.max_new_cap}"
             )
         self._bucket(len(ids))  # validate now, in the caller thread
+        if not self.is_coordinator:
+            raise NotCoordinator(
+                "this process is a follower in a distributed serve "
+                "gang; submit to the coordinator (process 0)"
+            )
         if self.spec_k is not None and (
             float(temperature) != 0.0 or float(repetition_penalty) != 1.0
         ):
@@ -1132,6 +1331,15 @@ class DecodeEngine:
         a concurrent second arm raises :class:`ProfileBusy` (HTTP 409).
         Capture failures fail THIS future only — never the fleet."""
         n = int(dispatches)
+        if self._dist is not None:
+            raise RuntimeError(
+                "on-demand device capture does not compose with "
+                "distributed serving yet (the window's drains and "
+                "barriers run on one process only, which would "
+                "desequence the gang) — profile a single-host daemon; "
+                "the gang-wide capture is the sharded-serving PR's "
+                "named follow-up"
+            )
         if not 1 <= n <= 1024:
             # the xplane parse + track merge run ON the loop thread at
             # the window close (a deliberate, bounded stall — it is an
@@ -1233,6 +1441,12 @@ class DecodeEngine:
             "kv_layout": self.kv_layout,
             "healthy": self.healthy,
         }
+        if self.mesh is not None:
+            # the /healthz mesh block: axis names/sizes, process
+            # count/index, and whether THIS process fronts the gang —
+            # what a fleet operator needs to see which daemon to
+            # target and how the pod is carved up
+            out["mesh"] = self._mesh_info()
         if self._pool is not None:
             out["live_slots"] = len(self._host)
             out["max_slots"] = self.max_slots
@@ -1303,6 +1517,32 @@ class DecodeEngine:
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
         return out
+
+    def _mesh_info(self) -> Dict[str, Any]:
+        """The mesh block behind stats()/healthz and the mesh gauges.
+        Tolerates placeholder mesh objects (construction-time tests):
+        axis/device info degrades to None, the process/coordinator
+        fields always answer."""
+        try:
+            axes = {str(k): int(v) for k, v in self.mesh.shape.items()}
+            devices = 1
+            for v in axes.values():
+                devices *= v
+        except Exception:
+            axes, devices = None, None
+        try:
+            procs = int(self._jax.process_count())
+            pidx = int(self._jax.process_index())
+        except Exception:
+            procs, pidx = 1, 0
+        return {
+            "axes": axes,
+            "devices": devices,
+            "processes": procs,
+            "process_index": pidx,
+            "coordinator": self.is_coordinator,
+            "distributed": self._dist is not None,
+        }
 
     def _pool_stats(self) -> Dict[str, Any]:
         """The page pool's stats with the HTTP-thread read race
@@ -1388,6 +1628,15 @@ class DecodeEngine:
         gau("mlcomp_engine_healthy",
             "1 while the drive loop is alive and unbroken, else 0",
             1 if self.healthy else 0)
+        if self.mesh is not None:
+            info = self._mesh_info()
+            gau("mlcomp_engine_mesh_devices",
+                "Devices in the serving mesh (sharded engines only)",
+                info["devices"] or 0)
+            gau("mlcomp_engine_is_coordinator",
+                "1 on the process that owns the submit queue (always "
+                "1 single-host; process 0 of a distributed gang)",
+                1 if info["coordinator"] else 0)
         gau("mlcomp_engine_slots", "Configured decode slots", self.slots)
         gau("mlcomp_engine_active_slots", "Slots currently decoding",
             sum(1 for s in self._host if s is not None))
@@ -1505,7 +1754,15 @@ class DecodeEngine:
         """
         self._stop.set()
         self._queue.put(_POISON)  # wake a blocked queue.get NOW
+        if self._dist is not None and not self._dist.is_coordinator:
+            # a follower loop blocks in the boundary-channel recv, not
+            # the queue: closing the channel is its poison pill
+            self._dist.close()
         self._thread.join(timeout=timeout)
+        if self._dist is not None:
+            # coordinator: the loop's finally already broadcast the
+            # stop record; release the sockets (idempotent)
+            self._dist.close()
         if self._watchdog is not None:
             self._watchdog.join(timeout=5.0)
         if self.prefix_cache is not None:
@@ -1576,6 +1833,12 @@ class DecodeEngine:
             except queue.Empty:
                 break
             if req is _POISON:
+                continue
+            if "ctrl" in req:
+                # a queued warm_on_loop record has a future but no
+                # stream/rid — fail it directly (a _fail_queued would
+                # KeyError and abort the drain mid-queue)
+                _fail_future(req["future"], err)
                 continue
             self._fail_queued(req, err)
 
@@ -1788,12 +2051,14 @@ class DecodeEngine:
             k = self.steps_per_dispatch
         out = self._fused_dispatch_fn(c, k)(
             self.variables, self._fresh_dstate(),
-            self._prefill_init_fn()(jnp.int32(0)),
-            jnp.zeros((1, c), jnp.int32),
-            jnp.zeros((1, c), jnp.int32),
-            jnp.ones((1, self.l_buf), jnp.bool_),
+            self._prefill_init_fn()(self._dev(0, np.int32)),
+            self._dev(np.zeros((1, c), np.int32)),
+            self._dev(np.zeros((1, c), np.int32)),
+            self._dev(np.ones((1, self.l_buf), bool)),
         )
-        np.asarray(out[2][0, 0])  # block until it really ran
+        # block until it really ran — on the PACKED output, which is
+        # replicated in a multi-process gang (the logits are not)
+        np.asarray(out[1][0, 0, 0])
         self._fused_warmed.add((c, k))
 
     def _prefill_chunk_fn(self, c: int):
@@ -1878,7 +2143,7 @@ class DecodeEngine:
                         packed[11].astype(jnp.int32)
                     )
                 out["active"] = dstate["active"].at[slot].set(True)
-                return out
+                return self._constrain_carry(out)
 
             # only dstate donates: the B=1 row buffers have no same-shape
             # output to reuse (donating them just emits warnings)
@@ -1899,7 +2164,7 @@ class DecodeEngine:
                 out = dict(dstate)
                 out["active"] = dstate["active"].at[slot].set(False)
                 out["remaining"] = dstate["remaining"].at[slot].set(0)
-                return out
+                return self._constrain_carry(out)
 
             self._fns["deactivate"] = jax.jit(deact, donate_argnums=(0,))
         return self._fns["deactivate"]
@@ -1924,7 +2189,7 @@ class DecodeEngine:
             def clear(dstate, slot):
                 out = dict(dstate)
                 out["table"] = dstate["table"].at[slot].set(grave)
-                return out
+                return self._constrain_carry(out)
 
             self._fns["clear_row"] = jax.jit(clear, donate_argnums=(0,))
         return self._fns["clear_row"]
@@ -1947,7 +2212,7 @@ class DecodeEngine:
             def set_table(dstate, table):
                 out = dict(dstate)
                 out["table"] = table
-                return out
+                return self._constrain_carry(out)
 
             self._fns["set_table"] = jax.jit(
                 set_table, donate_argnums=(0,)
@@ -2010,7 +2275,7 @@ class DecodeEngine:
                 # device first, then host — the same order the
                 # deadline/cancel retirement uses
                 self._dstate = self._deactivate_fn()(
-                    self._dstate, jnp.int32(i)
+                    self._dstate, self._dev(i, np.int32)
                 )
                 self._finish(i, error=err)
                 self._release_slot_pages(i)
@@ -2023,7 +2288,7 @@ class DecodeEngine:
             # tick (the host mirror is authoritative)
             self._dstate = self._set_table_fn()(
                 self._dstate,
-                jnp.asarray(pool.tables[: len(self._host)]),
+                self._dev(pool.tables[: len(self._host)]),
             )
 
     def _release_slot_pages(self, slot: int) -> None:  # graftcheck: runs-on(loop)
@@ -2035,7 +2300,7 @@ class DecodeEngine:
         if self._pool is None:
             return
         self._dstate = self._clear_row_fn()(
-            self._dstate, self._jnp.int32(slot)
+            self._dstate, self._dev(slot, np.int32)
         )
         self._pool.free_slot(slot)
 
@@ -2290,10 +2555,49 @@ class DecodeEngine:
             k = self.steps_per_dispatch
         key = ("dispatch", k)
         if key not in self._fns:
-            self._fns[key] = self._jax.jit(
-                self._carry_core(k), donate_argnums=(1,)
-            )
+            core = self._carry_core(k)
+            if self._carry_shardings is None and not self._multiproc:
+                self._fns[key] = self._jax.jit(
+                    core, donate_argnums=(1,)
+                )
+            else:
+                jax = self._jax
+
+                def dispatch_sharded(variables, dstate):
+                    out, packed = core(variables, dstate)
+                    # donation must PRESERVE shardings: re-pin the
+                    # carry to the shardings it was born with, so the
+                    # donated chain aliases buffers instead of
+                    # resharding mid-flight
+                    out = self._constrain_carry(out)
+                    packed = self._replicate_out(packed)
+                    return out, packed
+
+                self._fns[key] = jax.jit(
+                    dispatch_sharded, donate_argnums=(1,)
+                )
         return self._fns[key]
+
+    def _constrain_carry(self, out):
+        """Pin a carry-shaped output pytree to the engine's explicit
+        carry shardings (no-op when propagation owns them)."""
+        if self._carry_shardings is None:
+            return out
+        return self._jax.lax.with_sharding_constraint(
+            out, self._carry_shardings
+        )
+
+    def _replicate_out(self, x):
+        """Multi-process gangs read the packed token buffer back on
+        EVERY host (np.asarray needs a fully-replicated global array);
+        single-process engines gather whatever sharding XLA picked."""
+        if not self._multiproc:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return self._jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, PartitionSpec())
+        )
 
     def _dispatch_core(self, k: int):
         """The raw ``(variables, dstate) -> (dstate', packed)`` dispatch
@@ -2435,6 +2739,8 @@ class DecodeEngine:
                     positions=positions, kv_mask=kv_mask,
                     mutable=["cache"],
                 )
+                out = self._constrain_carry(out)
+                packed = self._replicate_out(packed)
                 return (out, packed, logits[:, -1].astype(jnp.float32),
                         upd["cache"])
 
@@ -2677,7 +2983,7 @@ class DecodeEngine:
         adm.positions = np.maximum(
             np.cumsum(rmask.astype(np.int64)) - 1, 0
         ).astype(np.int32)[None]
-        adm.kv_mask = jnp.asarray(np.concatenate(
+        adm.kv_mask = self._dev(np.concatenate(
             [rmask[None], np.ones((1, self.l_buf - s_bucket), bool)], axis=1
         ))
         # prefix-cache lookup: a hit fetches the cached prefix's K/V
@@ -2742,13 +3048,13 @@ class DecodeEngine:
                             n_pages = -(-width // self._pool.page_tokens)
                             rows = self._registry_rows_fn(width)(
                                 self._dstate["pages"],
-                                jnp.asarray(np.asarray(
+                                self._dev(
                                     lease.entries[:n_pages], np.int32
-                                )),
+                                ),
                             )
                             adm.cache = self._prefill_init_cached_fn(
                                 width
-                            )(jnp.int32(width), *rows)
+                            )(self._dev(width, np.int32), *rows)
                             adm.next_chunk = cached_chunk
                         else:
                             lease.release()
@@ -2828,7 +3134,9 @@ class DecodeEngine:
             # the open follow-up)
             adm.stall_ms += (time.perf_counter() - t_lookup) * 1e3
         if adm.cache is None:
-            adm.cache = self._prefill_init_fn()(jnp.int32(first_chunk * c))
+            adm.cache = self._prefill_init_fn()(
+                self._dev(first_chunk * c, np.int32)
+            )
         adm.capture_lo = adm.next_chunk * c
         self._adm = adm
 
@@ -2855,8 +3163,8 @@ class DecodeEngine:
             ):
                 logits, adm.cache = self._prefill_chunk_fn(c)(
                     self.variables, adm.cache,
-                    jnp.asarray(adm.row[:, lo:lo + c]),
-                    jnp.asarray(adm.positions[:, lo:lo + c]),
+                    self._dev(adm.row[:, lo:lo + c]),
+                    self._dev(adm.positions[:, lo:lo + c]),
                     adm.kv_mask,
                 )
         finally:
@@ -2890,11 +3198,10 @@ class DecodeEngine:
                 self._warm_fused_width(adm.chunk, self.steps_per_dispatch)
             finally:
                 self._busy_since = None
-        jnp = self._jnp
         c = adm.chunk
         lo = adm.next_chunk * c
-        return (jnp.asarray(adm.row[:, lo:lo + c]),
-                jnp.asarray(adm.positions[:, lo:lo + c]))
+        return (self._dev(adm.row[:, lo:lo + c]),
+                self._dev(adm.positions[:, lo:lo + c]))
 
     def _drain_inflight(self) -> None:  # graftcheck: runs-on(loop)
         """Resolve every in-flight dispatch (the recorded join_drain).
@@ -3351,7 +3658,7 @@ class DecodeEngine:
         if self.spec_k is not None:
             ids_np = np.zeros((1, self.t_ids), np.int32)
             ids_np[0, : len(req["ids"])] = req["ids"]
-            extra = (jnp.asarray(ids_np),)
+            extra = (self._dev(ids_np),)
         prow = None
         if self._pool is not None:
             # PAGED: compose the slot's table row host-side — NULL for
@@ -3397,7 +3704,7 @@ class DecodeEngine:
                     alloc_end=alloc_end,
                 )
             wsel = np.where(pmask, prow, GRAVE_PAGE).astype(np.int32)
-            extra = (jnp.asarray(prow), jnp.asarray(wsel)) + extra
+            extra = (self._dev(prow), self._dev(wsel)) + extra
         try:
             with self.recorder.span(
                 "insert", track="engine.loop", slot=slot,
@@ -3405,7 +3712,7 @@ class DecodeEngine:
             ):
                 self._dstate = self._insert_fn()(
                     self._dstate, adm.cache, adm.last_logits,
-                    jnp.asarray(row_presence), jnp.asarray(packed), *extra,
+                    self._dev(row_presence), self._dev(packed), *extra,
                 )
         except Exception:
             if prow is not None:
@@ -3710,6 +4017,16 @@ class DecodeEngine:
         try:
             self._loop_body()
         finally:
+            if self._dist is not None and self._dist.is_coordinator:
+                # whatever killed the coordinator's loop, the gang must
+                # not wedge in recv: broadcast the stop record (best
+                # effort — a dead channel means followers see it closed)
+                try:
+                    self._dist.send({"stop": True, "new": [],
+                                     "ctrl": [], "retired": [],
+                                     "k": self.steps_per_dispatch})
+                except Exception:
+                    pass
             # LOOP-OWNED final drain: whatever path ended the loop —
             # close(), a fatal error, a watchdog stall verdict, or a
             # wedged dispatch finally returning after an abandoned
@@ -3733,12 +4050,17 @@ class DecodeEngine:
 
     # ------------------------------------------------ boundary maintenance
 
-    def _pump_queue(self, block_s: float = 0.0) -> None:  # graftcheck: runs-on(loop)
+    def _pump_queue(self, block_s: float = 0.0):  # graftcheck: runs-on(loop)
         """Move everything parked in the thread-safe submit queue into
         the loop-owned ``_pending`` deque, where the deadline/cancel
         sweep can retire QUEUED requests at a dispatch boundary instead
         of only when a slot frees.  Blocks up to ``block_s`` for the
-        first item when the engine is idle."""
+        first item when the engine is idle.  Returns ``(new, ctrls)``
+        — the requests pumped THIS boundary and any control items
+        (``warm_on_loop``) — so a distributed coordinator can
+        broadcast exactly what entered the loop at this boundary."""
+        new: List[Dict[str, Any]] = []
+        ctrls: List[Dict[str, Any]] = []
         try:
             item = (
                 self._queue.get(timeout=block_s) if block_s
@@ -3748,11 +4070,15 @@ class DecodeEngine:
                 # skip poison pills and futures submit's close/broken
                 # race check already failed (their request must not be
                 # decoded by a restarted loop)
-                if item is not _POISON and not item["future"].done():
+                if item is not _POISON and "ctrl" in item:
+                    ctrls.append(item)
+                elif item is not _POISON and not item["future"].done():
                     self._pending.append(item)
+                    new.append(item)
                 item = self._queue.get_nowait()
         except queue.Empty:
             pass
+        return new, ctrls
 
     def _retire_check(
         self, req: Dict[str, Any], now: Optional[float] = None,
@@ -3782,7 +4108,8 @@ class DecodeEngine:
             self.recorder.instant("deadline", track="engine.loop", rid=rid)
         self._cancelled.discard(rid)
 
-    def _boundary_maintenance(self, block_s: float = 0.0) -> None:  # graftcheck: runs-on(loop)
+    def _boundary_maintenance(self, block_s: float = 0.0,
+                              include_adm: bool = False):  # graftcheck: runs-on(loop)
         """Per-boundary housekeeping (loop thread): pump the submit
         queue, then retire queued and active requests whose deadline
         passed or whose rid was cancelled.  Queued requests fail in
@@ -3791,13 +4118,25 @@ class DecodeEngine:
         budget) and its slot freed for the next admission.  Fault-free
         cost is one queue poll + an O(slots + pending) scan per
         boundary — gated <1% of dispatch wall by bench.py's resilience
-        A/B."""
-        self._pump_queue(block_s)
-        if not self._pending and not self._cancelled and all(
-            s is None or s.req.get("t_deadline") is None
-            for s in self._host
-        ):
-            return
+        A/B.
+
+        Returns ``(new, ctrls, retired)``: the requests/ctrl items
+        pumped this boundary and the ``(rid, status)`` retirements it
+        performed — a distributed coordinator broadcasts these so
+        followers replay the identical device sequence
+        (``include_adm`` folds the in-flight admission's verdict into
+        the same sweep; in single-host mode the loop body checks the
+        admission itself, time-rechecked, so the default stays off)."""
+        new, ctrls = self._pump_queue(block_s)
+        retired: List[Tuple[int, str]] = []
+        if (not self._pending and not self._cancelled
+                and (not include_adm or self._adm is None
+                     or self._adm.req.get("t_deadline") is None)
+                and all(
+                    s is None or s.req.get("t_deadline") is None
+                    for s in self._host
+                )):
+            return new, ctrls, retired
         now = time.perf_counter()
         if self._pending:
             kept: Deque[Dict[str, Any]] = deque()
@@ -3808,6 +4147,7 @@ class DecodeEngine:
                 else:
                     self._count_retire(err, req)
                     self._fail_queued(req, err)
+                    retired.append((req.get("rid", 0), err.status))
             self._pending = kept
         for i, sl in enumerate(self._host):
             if sl is None:
@@ -3820,10 +4160,18 @@ class DecodeEngine:
             # new admission may claim it, and the insert must not race
             # a still-active old row
             self._dstate = self._deactivate_fn()(
-                self._dstate, self._jnp.int32(i)
+                self._dstate, self._dev(i, np.int32)
             )
             self._finish(i, error=err)
             self._release_slot_pages(i)
+            retired.append((sl.req.get("rid", 0), err.status))
+        if include_adm and self._adm is not None:
+            err = self._retire_check(self._adm.req, now)
+            if err is not None:
+                retired.append((self._adm.req.get("rid", 0), err.status))
+                self._count_retire(err, self._adm.req)
+                self._fail_admission(err)
+        return new, ctrls, retired
 
     def _adaptive_tick(self) -> None:  # graftcheck: runs-on(loop)
         """Adaptive dispatch depth: one controller decision per
@@ -3851,6 +4199,165 @@ class DecodeEngine:
             queue_depth=depth, active=active,
         )
 
+    # ------------------------------------------------- distributed gang
+
+    _WIRE_KEYS = ("ids", "n_new", "temperature", "top_k", "top_p",
+                  "eos_id", "logprobs", "repetition_penalty", "rid",
+                  "trace_id", "warmup")
+
+    def _wire_out(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """The JSON-serializable subset of a request the coordinator
+        broadcasts: everything the loop's DEVICE sequence depends on.
+        Futures, streams, and wall-clock fields stay host-local —
+        deadlines are enforced by the coordinator's sweep and arrive
+        as explicit retirements."""
+        return {k: req[k] for k in self._WIRE_KEYS}
+
+    def _wire_in(self, w: Dict[str, Any]) -> Dict[str, Any]:
+        """Reconstruct a broadcast request on a follower: a fresh
+        (unread) Future, no stream, no local deadline — the follower's
+        tokens are discarded, its DEVICE work is the point."""
+        fut: Future = Future()
+        fut.rid = w.get("rid", 0)
+        fut.trace_id = w.get("trace_id")
+        return {
+            **{k: w[k] for k in self._WIRE_KEYS},
+            "future": fut, "stream": None,
+            "t_submit": time.perf_counter(), "t_deadline": None,
+        }
+
+    def warm_on_loop(self) -> Future:
+        """Distributed warmup: run the warm_* precompiles ON the loop
+        thread at a boundary (broadcast as a ctrl record, so followers
+        compile the same programs at the same point in the device
+        sequence — a main-thread warm call would interleave SPMD
+        programs nondeterministically against the gang's loop
+        dispatches).  Resolves to the program count."""
+        if self._dist is None:
+            raise RuntimeError(
+                "warm_on_loop is the distributed warmup path; "
+                "single-host services call the warm_* fns directly"
+            )
+        if not self.is_coordinator:
+            raise RuntimeError(
+                "warm_on_loop runs on the coordinator; followers "
+                "replay the broadcast ctrl record"
+            )
+        fut: Future = Future()
+        self._queue.put({"ctrl": "warm", "future": fut})
+        if self._stop.is_set() or self._broken is not None:
+            # same closed-engine race check as submit(): close() may
+            # have drained the queue between the guards above and our
+            # put — resolve the future ourselves (idempotent)
+            _fail_future(fut, self._broken or RuntimeError(
+                "decode engine closed"
+            ))
+        return fut
+
+    def _run_ctrl(self, kind: str,
+                  fut: Optional[Future] = None) -> None:  # graftcheck: runs-on(loop)
+        if kind != "warm":
+            raise RuntimeError(f"unknown ctrl record {kind!r}")
+        self._busy_since = time.perf_counter()  # compiles are busy time
+        try:
+            n = (self.warm_prefix_fns() + self.warm_dispatch_fns()
+                 + self.warm_fused_fns())
+        except BaseException as e:
+            # the waiter must see the real compile error, not a
+            # request-timeout masking it; the loop's own break
+            # handling still runs (re-raise)
+            if fut is not None:
+                _fail_future(fut, e)
+            raise
+        finally:
+            self._busy_since = None
+        if fut is not None:
+            _set_result(fut, n)
+
+    def _apply_retired(self, retired) -> None:  # graftcheck: runs-on(loop)
+        """Follower half of the retirement broadcast: perform exactly
+        the coordinator's retirements, in its order — queued requests
+        fail in place, active rows deactivate ON DEVICE in the same
+        slot order (the carries must stay bit-identical), a retired
+        admission tears down mid-prefill."""
+        for rid, status in retired:
+            rid = int(rid)
+            err: Exception = (
+                RequestCancelled(f"request {rid} cancelled (broadcast)")
+                if status == RequestCancelled.status
+                else DeadlineExceeded(
+                    f"request {rid} exceeded its deadline (broadcast)"
+                )
+            )
+            hit = None
+            for req in self._pending:
+                if req.get("rid") == rid:
+                    hit = req
+                    break
+            if hit is not None:
+                self._pending.remove(hit)
+                self._count_retire(err, hit)
+                self._fail_queued(hit, err)
+                continue
+            adm = self._adm
+            if adm is not None and adm.req.get("rid") == rid:
+                self._count_retire(err, adm.req)
+                self._fail_admission(err)
+                continue
+            for i, sl in enumerate(self._host):
+                if sl is not None and sl.req.get("rid") == rid:
+                    self._count_retire(err, sl.req)
+                    self._dstate = self._deactivate_fn()(
+                        self._dstate, self._dev(i, np.int32)
+                    )
+                    self._finish(i, error=err)
+                    self._release_slot_pages(i)
+                    break
+
+    def _sync_boundary(self, idle: bool) -> bool:  # graftcheck: runs-on(loop)
+        """ONE gang boundary.  Coordinator: pump + sweep + pick K,
+        broadcast the record, run any ctrl items.  Follower: receive
+        the record and replay it — enqueue the broadcast requests,
+        perform the broadcast retirements, adopt the broadcast K, run
+        the ctrl items.  After this returns True both sides run the
+        IDENTICAL remaining loop body (admission starts, chunk
+        issues, inserts, dispatches are all deterministic functions
+        of the shared state), so every process emits the same device
+        program sequence.  False = the gang is shutting down."""
+        dist = self._dist
+        if dist.is_coordinator:
+            new, ctrls, retired = self._boundary_maintenance(
+                block_s=0.2 if idle else 0.0, include_adm=True,
+            )
+            self._adaptive_tick()
+            dist.send({
+                "new": [self._wire_out(r) for r in new],
+                "ctrl": [c["ctrl"] for c in ctrls],
+                "retired": retired,
+                "k": self.steps_per_dispatch,
+            })
+            for c in ctrls:
+                self._run_ctrl(c["ctrl"], c.get("future"))
+            return True
+        from mlcomp_tpu.parallel.distributed import ChannelClosed
+
+        try:
+            rec = dist.recv()
+        except ChannelClosed:
+            return False
+        if rec.get("stop"):
+            return False
+        for w in rec.get("new", ()):
+            self._pending.append(self._wire_in(w))
+        self._apply_retired(rec.get("retired", ()))
+        k2 = int(rec.get("k", self.steps_per_dispatch))
+        if k2 != self.steps_per_dispatch:
+            self.steps_per_dispatch = k2
+            self._stats["dispatch_k_changes"] += 1
+        for kind in rec.get("ctrl", ()):
+            self._run_ctrl(kind)
+        return True
+
     # -------------------------------------------------------- drive loop
 
     def _loop_body(self) -> None:  # graftcheck: runs-on(loop)
@@ -3877,11 +4384,21 @@ class DecodeEngine:
                     and not self._pending
                     and all(s is None for s in self._host)
                 )
-                self._boundary_maintenance(block_s=0.2 if idle else 0.0)
-                # adaptive dispatch depth: pick this boundary's K from
-                # the live load signals BEFORE any issue below (the
-                # fused program family is K-keyed too)
-                self._adaptive_tick()
+                if self._dist is not None:
+                    # distributed gang: the boundary's admissions,
+                    # retirements, and K all flow through the
+                    # coordinator's broadcast so every process runs
+                    # the identical device sequence
+                    if not self._sync_boundary(idle):
+                        return
+                else:
+                    self._boundary_maintenance(
+                        block_s=0.2 if idle else 0.0
+                    )
+                    # adaptive dispatch depth: pick this boundary's K
+                    # from the live load signals BEFORE any issue
+                    # below (the fused program family is K-keyed too)
+                    self._adaptive_tick()
                 # on-demand device capture (GET /profile): start/stop
                 # the trace window at this boundary when one is armed
                 self._profile_tick()
@@ -3910,9 +4427,12 @@ class DecodeEngine:
                             self._start_admission(req)
                         except Exception as e:
                             self._fail_queued(req, e)
-                if self._adm is not None:
+                if self._adm is not None and self._dist is None:
                     # a cancel/deadline landing mid-prefill retires the
-                    # admission between its chunks
+                    # admission between its chunks.  Distributed gangs
+                    # retire ONLY at the broadcast boundary (a local
+                    # time re-check here would diverge the gang's
+                    # device sequence)
                     err = self._retire_check(self._adm.req)
                     if err is not None:
                         self._count_retire(err, self._adm.req)
@@ -4095,6 +4615,18 @@ class DecodeEngine:
         when the loop died again without resolving a single dispatch
         since the last restart."""
         if self._abandoned or self._stop.is_set():
+            return False
+        if self._dist is not None:
+            # a lone restarted process would rebuild a FRESH local
+            # carry against a gang mid-sequence — guaranteed
+            # divergence.  Stay down; the fleet manager replaces the
+            # whole gang (gang-coordinated restart is the named
+            # follow-up).
+            self._unhealthy_reason = (
+                "drive loop died in a distributed gang; watchdog "
+                "restarts are disabled (a lone fresh carry would "
+                "diverge from the gang) — restart the gang"
+            )
             return False
         d = self._stats["dispatches"]
         if (self._dispatches_at_restart is not None

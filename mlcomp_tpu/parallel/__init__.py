@@ -5,6 +5,8 @@ from mlcomp_tpu.parallel.mesh import (
     replicated,
 )
 from mlcomp_tpu.parallel.distributed import (
+    BoundaryChannel,
+    ChannelClosed,
     init_distributed,
     make_hybrid_mesh,
     global_batch_from_host,
@@ -16,6 +18,8 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "replicated",
+    "BoundaryChannel",
+    "ChannelClosed",
     "init_distributed",
     "make_hybrid_mesh",
     "global_batch_from_host",

@@ -23,6 +23,7 @@ Hybrid topology rule (the scaling-book recipe): bandwidth-hungry axes
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Dict, Optional, Sequence
 
@@ -196,6 +197,211 @@ def make_hybrid_mesh(
     # (dcn_a * ici_a per axis) == (sizes[a] for a in AXES), dcn-major
     # within each axis — already the layout Mesh expects
     return Mesh(arr, AXES)
+
+
+class ChannelClosed(RuntimeError):
+    """The boundary channel's peer went away (coordinator shut down,
+    or ``close()`` was called locally) — the follower loop treats it
+    as the stop record."""
+
+
+class BoundaryChannel:
+    """Coordinator -> followers broadcast of per-boundary serve
+    decisions (``serve --distributed``): length-prefixed JSON records
+    over plain TCP.
+
+    Design constraint: the channel must carry HOST decisions with NO
+    device collectives — the engine's loop thread broadcasts while
+    other threads (HTTP handlers, the metrics sampler) run, and a
+    collective-based broadcast (``multihost_utils.broadcast_one_to_all``
+    lowers to a psum over every device) would interleave device
+    programs nondeterministically across the gang, which is exactly
+    the hazard the channel exists to prevent.  TCP ordering gives the
+    followers the coordinator's record sequence verbatim; socket
+    backpressure bounds how far ahead the coordinator can run.
+
+    Wire format: 4-byte big-endian length + UTF-8 JSON per record.
+    The port defaults to ``MLCOMP_TPU_SYNC_PORT``, else the
+    ``jax.distributed`` coordinator port + 1 (same host).  With one
+    process the channel is inert (send is a no-op) so the same serve
+    path runs single-host unchanged.
+    """
+
+    def __init__(
+        self,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+        address: Optional[str] = None,
+        port: Optional[int] = None,
+        timeout_s: float = 120.0,
+    ):
+        import socket
+        import struct
+        import threading
+
+        self._struct = struct
+        self.num_processes = int(
+            num_processes if num_processes is not None
+            else jax.process_count()
+        )
+        self.process_id = int(
+            process_id if process_id is not None else jax.process_index()
+        )
+        self.is_coordinator = self.process_id == 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self._conns: list = []
+        self._sock = None
+        if self.num_processes <= 1:
+            return
+        coord = address or os.environ.get("MLCOMP_TPU_COORDINATOR", "")
+        if coord:
+            host = coord.rsplit(":", 1)[0] if ":" in coord else coord
+        elif self.is_coordinator:
+            host = ""  # the coordinator binds all interfaces, no dial
+        else:
+            # a silent 127.0.0.1 fallback would dial localhost on a
+            # real pod (TPU auto-discovery sets no env) and spin until
+            # the connect timeout — reject loudly like the port case
+            raise ValueError(
+                "BoundaryChannel follower needs the coordinator host: "
+                "pass address= or set MLCOMP_TPU_COORDINATOR (with "
+                "jax.distributed TPU auto-discovery the JAX runtime "
+                "finds its own coordinator, but the boundary side "
+                "channel still needs the address)"
+            )
+        if port is None:
+            env_port = os.environ.get("MLCOMP_TPU_SYNC_PORT")
+            if env_port:
+                port = int(env_port)
+            elif ":" in coord:
+                port = int(coord.rsplit(":", 1)[1]) + 1
+            else:
+                raise ValueError(
+                    "BoundaryChannel needs a port: pass port=, set "
+                    "MLCOMP_TPU_SYNC_PORT, or set MLCOMP_TPU_COORDINATOR "
+                    "(its port + 1 is the default)"
+                )
+        if self.is_coordinator:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("", int(port)))
+            srv.listen(self.num_processes)
+            srv.settimeout(timeout_s)
+            try:
+                for _ in range(self.num_processes - 1):
+                    conn, _addr = srv.accept()
+                    conn.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                    # a follower that stops reading (wedged loop) must
+                    # not block the coordinator's sendall forever: a
+                    # timed-out send drops the follower like a dead one
+                    conn.settimeout(timeout_s)
+                    self._conns.append(conn)
+            finally:
+                srv.close()
+        else:
+            deadline = None
+            import time as _time
+
+            deadline = _time.monotonic() + timeout_s
+            last_err: Optional[Exception] = None
+            while _time.monotonic() < deadline:
+                try:
+                    s = socket.create_connection(
+                        (host, int(port)), timeout=5.0
+                    )
+                    s.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                    s.settimeout(None)
+                    self._sock = s
+                    break
+                except OSError as e:  # coordinator not listening yet
+                    last_err = e
+                    _time.sleep(0.2)
+            if self._sock is None:
+                raise ChannelClosed(
+                    f"could not reach the boundary channel at "
+                    f"{host}:{port} within {timeout_s}s: {last_err!r}"
+                )
+
+    def send(self, obj) -> None:
+        """Broadcast one record (coordinator only; no-op single
+        process).  A follower whose socket died is dropped — the
+        gang's SPMD programs will surface the real failure."""
+        assert self.is_coordinator, "only the coordinator sends"
+        if not self._conns:
+            return
+        body = json.dumps(obj).encode()
+        frame = self._struct.pack(">I", len(body)) + body
+        with self._lock:
+            dead = []
+            for conn in self._conns:
+                try:
+                    conn.sendall(frame)
+                except OSError:
+                    dead.append(conn)
+            for conn in dead:
+                self._conns.remove(conn)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _recv_exact(self, n: int) -> bytes:
+        # snapshot the socket once: a concurrent close() nulls
+        # self._sock under the lock, and re-reading it mid-loop would
+        # surface that clean shutdown as an AttributeError instead of
+        # ChannelClosed
+        sock = self._sock
+        if sock is None:
+            raise ChannelClosed("boundary channel is closed")
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = sock.recv(n - len(buf))
+            except OSError as e:
+                raise ChannelClosed(f"boundary channel lost: {e}")
+            if not chunk:
+                raise ChannelClosed("boundary channel closed by peer")
+            buf += chunk
+        return buf
+
+    def recv(self):
+        """Block for the next record (followers only).  Raises
+        :class:`ChannelClosed` when the coordinator goes away or
+        ``close()`` is called from another thread."""
+        assert not self.is_coordinator, "the coordinator never recvs"
+        if self._closed or self._sock is None:
+            raise ChannelClosed("boundary channel is closed")
+        (n,) = self._struct.unpack(">I", self._recv_exact(4))
+        return json.loads(self._recv_exact(n).decode())
+
+    def close(self) -> None:
+        """Idempotent teardown; unblocks a follower's in-flight
+        ``recv`` with :class:`ChannelClosed`."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            socks = list(self._conns)
+            self._conns = []
+            if self._sock is not None:
+                socks.append(self._sock)
+                self._sock = None
+        import socket as _socket
+
+        for s in socks:
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 def process_count() -> int:

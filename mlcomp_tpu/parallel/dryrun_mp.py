@@ -49,6 +49,7 @@ def main() -> int:
     )
     tx = create_optimizer({"name": "sgd", "lr": 0.1})
     state = TrainState.create(model.apply, params, tx, model_state)
+    # graftcheck: ignore[donation-sharding] -- construction-time placement; the one donating step call below rebinds state in the same statement
     state = jax.device_put(state, replicated(mesh))
 
     # every process assembles the same global batch; each contributes the

@@ -63,7 +63,11 @@ HTTP surface (stdlib http.server, same conventions as report/server.py):
         restart.  ``ready`` is readiness, distinct from liveness:
         false while warmup compiles run or the daemon is draining —
         the fleet router routes around a not-ready replica without
-        the manager restarting it)
+        the manager restarting it.  Sharded daemons carry a ``mesh``
+        block — axis names/sizes, process count/index, coordinator
+        flag; a ``serve --distributed`` FOLLOWER answers
+        ``ready: false`` so only the gang's coordinator takes
+        traffic)
     POST /drain     {"draining": true|false} -> flip readiness for the
         scale-down handshake: a draining daemon finishes in-flight
         work, stays ok, and advertises ready=false
@@ -121,7 +125,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from mlcomp_tpu.engine import DeadlineExceeded, _fail_future
+from mlcomp_tpu.engine import DeadlineExceeded, NotCoordinator, _fail_future
 from mlcomp_tpu.utils.trace import (
     filter_export,
     make_trace_id,
@@ -219,6 +223,7 @@ class GenerationService:
         max_slots: Optional[int] = None,
         metrics_history_interval: Optional[float] = 5.0,
         slo_config: Optional[Dict[str, Any]] = None,
+        dist=None,
     ):
         import jax
 
@@ -235,6 +240,26 @@ class GenerationService:
         # decode_attention.sharded_decode_attention) — validated here
         # for the layouts those wrappers support.
         self.mesh = mesh
+        # multi-host serve gang (serve --distributed): a
+        # parallel/distributed.BoundaryChannel.  Process 0 (the
+        # coordinator) owns the HTTP front door and submit queue;
+        # every other process is a FOLLOWER that replays the
+        # coordinator's broadcast boundary decisions and answers
+        # /healthz as ready:false so the fleet router never targets it.
+        self.dist = dist
+        if dist is not None:
+            if batcher not in ("auto", "continuous"):
+                raise ValueError(
+                    "distributed serving needs the continuous batcher "
+                    "(only the slot engine has a boundary loop to "
+                    "synchronize)"
+                )
+            if mesh is None:
+                raise ValueError(
+                    "distributed serving needs a mesh (--mesh): the "
+                    "gang runs one SPMD program over the global device "
+                    "mesh"
+                )
         if mesh is not None:
             dbatch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
             bad = [b for b in batch_sizes if b % dbatch]
@@ -528,6 +553,7 @@ class GenerationService:
                 kv_page_tokens=kv_page_tokens,
                 kv_pages=kv_pages,
                 max_slots=max_slots,
+                dist=dist,
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous
@@ -915,6 +941,13 @@ class GenerationService:
         import jax.numpy as jnp
 
         if self.engine is not None:
+            if self.dist is not None and not self.engine.is_coordinator:
+                # followers compile by REPLAY: the coordinator's warmup
+                # submissions and its warm ctrl record arrive over the
+                # boundary channel and run on the follower's loop
+                # thread in the same order — a local warmup here would
+                # issue SPMD programs off-loop and desequence the gang
+                return 0
             # one dummy request per prompt bucket compiles that bucket's
             # prefill; the first compiles the shared insert + step too
             n_new = min(2, self.engine.max_new_cap)
@@ -934,6 +967,14 @@ class GenerationService:
             # width per rung) — without this the first real request /
             # first overlapped admission / first K switch pays their
             # compile on the engine loop thread mid-serving
+            if self.dist is not None:
+                # distributed: the warm fns must run ON the loop
+                # thread at a broadcast boundary so every process
+                # compiles them at the same point in the device
+                # sequence
+                return len(futs) + self.engine.warm_on_loop().result(
+                    timeout=self.request_timeout_s
+                )
             return (len(futs) + self.engine.warm_prefix_fns()
                     + self.engine.warm_dispatch_fns()
                     + self.engine.warm_fused_fns())
@@ -1026,6 +1067,12 @@ class GenerationService:
                 # live elastic slot count without digging
                 out["kv_pool"] = eng["kv_pool"]
                 out["live_slots"] = eng.get("live_slots")
+            if "mesh" in eng:
+                # sharded serving at the top level: axis names/sizes,
+                # process count/index, coordinator flag — the /healthz
+                # mesh block fleet operators read to find the gang's
+                # front door
+                out["mesh"] = eng["mesh"]
             out["engine"] = eng
         if self.slo is not None:
             # the SLO verdict rides /healthz: which objectives are
@@ -1035,10 +1082,14 @@ class GenerationService:
             out["metrics_history"] = self.history.stats()
         # readiness is liveness minus "can take NEW traffic": warmup
         # compiles and deliberate drains clear it without touching ok —
-        # the router reads ready, the manager reads ok
+        # the router reads ready, the manager reads ok.  A distributed
+        # FOLLOWER is never ready (it owns no submit queue; it is
+        # healthy while it replays the coordinator's boundaries), so
+        # the fleet router only ever targets the gang's front door.
         out["draining"] = self._draining
         out["ready"] = bool(
             out["healthy"] and not self._draining and not self._warming
+            and (self.dist is None or self.dist.is_coordinator)
         )
         return out
 
@@ -1870,6 +1921,15 @@ def make_http_server(
                 self.end_headers()
                 self.wfile.write(body)
                 return None
+            except NotCoordinator as e:
+                # a distributed follower: traffic belongs at the
+                # coordinator — 503 + the body says where to look
+                # (its /healthz already answers ready:false, so a
+                # fleet router never lands here)
+                return self._json(
+                    {"error": str(e), "status": e.status,
+                     "trace_id": tid}, 503,
+                )
             except (DeadlineExceeded, FutTimeout) as e:
                 return self._json(
                     {"error": f"{type(e).__name__}: {e}",

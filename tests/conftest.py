@@ -41,6 +41,31 @@ def tmp_db(tmp_path):
     return str(tmp_path / "mlcomp.sqlite")
 
 
+# compiled-program pool per engine config (the _fns idiom from
+# tests/test_engine_fused_admit.py), shared by the engine test files:
+# pipeline depth is HOST-side only, so e.g. the depth-1 and depth-2
+# arms of an equality pair share the same jitted
+# dispatch/prefill/insert programs — compile once per key, not once
+# per engine.  Keys are per-file tuples; files must not collide.
+ENGINE_FNS_POOL: dict = {}
+
+
+def share_engine_fns(eng, key):
+    pool = ENGINE_FNS_POOL.setdefault(key, {})
+    eng._fns.update(pool)
+    eng._fns_pool = pool
+    return eng
+
+
+def close_pooled_engine(eng):
+    """Harvest the engine's compiled programs back into its pool,
+    then close — the update must precede close() so programs compiled
+    by THIS engine survive for the next one."""
+    if hasattr(eng, "_fns_pool"):
+        eng._fns_pool.update(eng._fns)
+    eng.close()
+
+
 @pytest.fixture(autouse=True)
 def _clear_process_mesh():
     """The installed mesh is a process-wide global (production installs

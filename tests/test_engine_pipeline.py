@@ -19,25 +19,10 @@ from mlcomp_tpu.serve import GenerationService
 from mlcomp_tpu.train.state import init_model
 
 
-# compiled-program pool per engine config (the _fns idiom from
-# tests/test_engine_fused_admit.py): pipeline depth is HOST-side only,
-# so the depth-1 and depth-2 arms of every equality pair share the
-# same jitted dispatch/prefill/insert programs — compile once per
-# (kv_quant, config) instead of once per engine
-_FNS: dict = {}
-
-
-def _share(eng, key):
-    pool = _FNS.setdefault(key, {})
-    eng._fns.update(pool)
-    eng._fns_pool = pool
-    return eng
-
-
-def _close(eng):
-    if hasattr(eng, "_fns_pool"):
-        eng._fns_pool.update(eng._fns)
-    eng.close()
+from conftest import (  # the shared compiled-program pool idiom
+    close_pooled_engine as _close,
+    share_engine_fns as _share,
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -198,21 +183,19 @@ def test_close_with_dispatch_in_flight_fails_pending_exactly_once():
         eng.submit([1], 2)
 
 
-def test_pipeline_depth_validation_and_mesh_rejection():
-    """Depth < 1 and explicit depth > 1 under a mesh are rejected at
-    construction (not silently degraded); the DEFAULT under a mesh
-    resolves to the synchronous loop; depth > 1 at the service level
-    needs the continuous batcher."""
+def test_pipeline_depth_validation_and_mesh_default():
+    """Depth < 1 is rejected at construction; the default is depth 2
+    EVERYWHERE — mesh or not, since the sharded-serving PR (the old
+    mesh rejection is gone; tests/test_engine_sharded.py pins the
+    sharded equalities); depth > 1 at the service level needs the
+    continuous batcher."""
     model, params = _model_and_params()
     kw = dict(slots=2, prompt_buckets=(16,), max_new_cap=8)
     with pytest.raises(ValueError, match="pipeline_depth"):
         DecodeEngine(model, {"params": params}, pipeline_depth=0, **kw)
-    with pytest.raises(ValueError, match="single-chip"):
-        DecodeEngine(model, {"params": params}, mesh=object(),
-                     pipeline_depth=2, **kw)
     eng = DecodeEngine(model, {"params": params}, mesh=object(), **kw)
     try:
-        assert eng.pipeline_depth == 1  # mesh default: synchronous
+        assert eng.pipeline_depth == 2  # mesh default: pipelined too
     finally:
         eng.close()
     eng = DecodeEngine(model, {"params": params}, **kw)

@@ -136,6 +136,79 @@ def test_donation_vector_consistency():
     assert len(fs) == 1, [f.render() for f in fs]
 
 
+def test_donation_sharding_flags_reshard_of_donated_name():
+    """The mesh-aware rule: resharding a donated carry name
+    (device_put / with_sharding_constraint) in the same function that
+    donates it flags — order-insensitive, because loop bodies donate
+    and reuse across iterations."""
+    mi = _mi(
+        """
+        import jax
+
+        def dispatch(variables, dstate):
+            return dstate
+
+        class Eng:
+            def _dispatch_fn(self):
+                return jax.jit(dispatch, donate_argnums=(1,))
+
+            def loop(self, sharding):
+                while True:
+                    self._dstate = jax.device_put(
+                        self._dstate, sharding
+                    )
+                    self._dstate = self._dispatch_fn()(
+                        self.variables, self._dstate
+                    )
+
+            def loop2(self, sharding):
+                while True:
+                    self._dstate = jax.lax.with_sharding_constraint(
+                        self._dstate, sharding
+                    )
+                    self._dstate = self._dispatch_fn()(
+                        self.variables, self._dstate
+                    )
+        """
+    )
+    fs = [f for f in graftcheck.check_donation(mi)
+          if f.rule == "donation-sharding"]
+    assert len(fs) == 2, [f.render() for f in fs]
+    assert "device_put" in fs[0].message
+
+
+def test_donation_sharding_clean_when_resharding_other_names():
+    """In-trace constraints on NON-donated values (the engine's
+    _constrain_carry on the traced output) and construction-time
+    placement in a DIFFERENT function stay clean."""
+    mi = _mi(
+        """
+        import jax
+
+        def dispatch(variables, dstate):
+            out = dict(dstate)
+            out = jax.lax.with_sharding_constraint(out, None)
+            return out
+
+        class Eng:
+            def _dispatch_fn(self):
+                return jax.jit(dispatch, donate_argnums=(1,))
+
+            def fresh(self, sharding):
+                self._dstate = jax.device_put(self.init(), sharding)
+
+            def loop(self):
+                while True:
+                    self._dstate = self._dispatch_fn()(
+                        self.variables, self._dstate
+                    )
+        """
+    )
+    fs = [f for f in graftcheck.check_donation(mi)
+          if f.rule == "donation-sharding"]
+    assert not fs, [f.render() for f in fs]
+
+
 # ---------------------------------------------------------------- trace
 
 

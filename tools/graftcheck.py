@@ -24,6 +24,14 @@ donation-vector   a function with a ``dstate`` parameter (the
                   that argument — carry programs must share one
                   donation story or the pipeline's in-place chain
                   breaks
+donation-sharding a name that is DONATED in a function is also passed
+                  to ``jax.device_put`` / ``with_sharding_constraint``
+                  in that function — resharding a donated carry
+                  between issue and reuse changes the buffer's
+                  sharding out from under the donation chain (the
+                  next call recompiles or silently copies instead of
+                  aliasing); reshard at construction (the fresh
+                  carry's jitted init), never mid-chain
 host-sync         ``bool()/int()/float()``, ``.item()``, or a
                   ``np.*`` call on a traced value inside a
                   jit-reachable function (an implicit device sync,
@@ -107,7 +115,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ALL_RULES = (
-    "use-after-donate", "donation-vector",
+    "use-after-donate", "donation-vector", "donation-sharding",
     "host-sync", "tracer-control-flow", "traced-time",
     "unguarded-write", "unguarded-read", "bad-annotation",
     "metric-drift", "env-drift", "fault-drift", "flag-drift",
@@ -136,6 +144,9 @@ CONDITIONAL_METRICS = {
     # window/speculative batchers only (the daemon runs continuous)
     "mlcomp_service_requests_total",
     "mlcomp_service_queue_depth",
+    # sharded engines only (the tier-1 obs_check daemon is mesh-less)
+    "mlcomp_engine_mesh_devices",
+    "mlcomp_engine_is_coordinator",
 }
 
 MUTATOR_METHODS = {
@@ -533,6 +544,8 @@ def check_donation(mi: ModuleInfo) -> List[Finding]:
         flatten(fn.body)
         stmts.sort(key=lambda s: s.lineno)
         tainted: Dict[str, int] = {}  # expr text -> donating call line
+        donated_any: Dict[str, int] = {}  # name -> first donation line
+        reshards: List[Tuple[str, int, str]] = []  # (name, line, fn)
         for stmt in stmts:
             nodes = _own_nodes(stmt)
             rebound = _assign_targets_texts(stmt)
@@ -559,6 +572,13 @@ def check_donation(mi: ModuleInfo) -> List[Finding]:
             for node in nodes:
                 if not isinstance(node, ast.Call):
                     continue
+                name = dotted(node.func) or ""
+                leaf = name.split(".")[-1]
+                if leaf in ("device_put", "with_sharding_constraint"
+                            ) and node.args:
+                    txt = dotted(node.args[0])
+                    if txt is not None:
+                        reshards.append((txt, node.lineno, leaf))
                 vec = call_vector(node, scope_ids)
                 if not vec:
                     continue
@@ -568,9 +588,30 @@ def check_donation(mi: ModuleInfo) -> List[Finding]:
                     txt = dotted(node.args[idx])
                     if txt is None:
                         continue
+                    donated_any.setdefault(txt, node.lineno)
                     if txt in rebound:
                         continue  # the same stmt rebinds it (the idiom)
                     tainted[txt] = node.lineno
+        # donation-sharding: the same function both DONATES a name and
+        # reshards it (device_put / with_sharding_constraint) — the
+        # donated chain's buffer sharding changes between issue and
+        # reuse, so the next donating call recompiles or copies
+        # instead of aliasing.  Deliberately order-insensitive: loop
+        # bodies donate and reuse across iterations, so a reshard
+        # "before" the donation in source order still hits the chain
+        # (a genuine construct-then-donate sequence in one function is
+        # rare — suppress with a reason).
+        for txt, line, how in reshards:
+            if txt in donated_any:
+                findings.append(Finding(
+                    "donation-sharding", mi.rel, line,
+                    f"'{txt}' is donated in this function (line "
+                    f"{donated_any[txt]}) and resharded here by "
+                    f"{how} — donation vectors must preserve "
+                    "shardings: reshard at construction (the fresh "
+                    "carry's jitted init with out_shardings), never "
+                    "between issue and reuse",
+                ))
     return findings
 
 
@@ -1225,8 +1266,12 @@ def check_drift(root: str,
     serving_md = read("docs/serving.md")
     obs_md = read("docs/observability.md")
 
-    # ---- env vars: code set vs the serving.md table
-    env_code = collect_env_vars({**code, **tools_mods})
+    # ---- env vars: code set vs the serving.md table.  The driver
+    # entry (__graft_entry__.py) reads bench-style skip envs for its
+    # dryrun blocks — part of the env contract, scanned here only
+    # (its donation/trace story is the dryruns' own)
+    entry_mods = load_modules(root, ["__graft_entry__.py"])
+    env_code = collect_env_vars({**code, **tools_mods, **entry_mods})
     env_docs = parse_env_table(serving_md)
     if "## Environment variables" not in serving_md:
         findings.append(Finding(
@@ -1472,7 +1517,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.list_env or args.list_metrics or args.list_faults:
         code = load_modules(args.root, python_files(
             args.root, ("mlcomp_tpu", "bench.py", "tools")
-        ))
+        ) + ["__graft_entry__.py"])
         if args.list_env:
             for name, sites in sorted(collect_env_vars(code).items()):
                 rel, line, kind = sites[0]
