@@ -2535,6 +2535,293 @@ def bench_scheduler() -> None:
     }))
 
 
+def bench_disaggregation(ctx=None) -> None:
+    """DISAGGREGATED SERVING line (ROADMAP item 3): 1 prefill replica
+    + 1 decode replica vs 2 monolithic replicas on a MIXED trace at
+    equal chips, with KV pages as the transfer currency.
+
+    Protocol (tunnel-safe, in-process): both arms run their replica
+    pair as real engines with live loop threads on this process's
+    device, so "equal chips" is equal total chip-WORK — wall clock on
+    the shared chip is proportional to combined device time either
+    way, and the tunnel's per-dispatch overhead inflates both arms
+    alike.  The trace mixes prefill-heavy requests (full prompt, tiny
+    decode budget) with decode-heavy ones (full prompt, full budget),
+    shuffled:
+
+    - MONOLITHIC arm: two paged engines, half the slots each (the
+      per-replica slot count a 2-way fleet actually gets), each
+      serving half the trace — admission chunks interleave with (and
+      stall/ride) each replica's own decode dispatches, and every
+      dispatch amortizes over at most slots/2 rows.
+    - SPLIT arm: a ``prefill_only`` engine exports every finished
+      prompt as a page-payload handoff; a full-slot decode engine
+      imports them (one insert, no chunks) and runs pure decode
+      dispatches amortized over ALL slots.
+
+    ``value`` is the split arm's decode tokens/s over the trace;
+    ``vs_baseline`` is split/monolithic against the >= 1.0 acceptance
+    bar.  ``import_bit_exact`` re-proves transferred-page decode
+    equality on this config (tokens + logprobs vs a monolithic
+    admission), and both leak counters must read 0 at quiesce.
+
+    Also emitted: ``fleet_router_proxy_rps`` — the router's proxy
+    ceiling before/after upstream keep-alive pooling (PR satellite,
+    ROADMAP item 2), measured against a canned stub upstream so the
+    probe isolates the ROUTER path (connection setup + relay), not
+    model time.
+    """
+    import gc
+    import threading
+    from concurrent.futures import as_completed
+
+    from mlcomp_tpu.engine import DecodeEngine
+
+    if ctx is not None and "model" in ctx:
+        model, qvars = ctx["model"], ctx["qvars"]
+        gen = np.random.default_rng(17)
+    else:
+        ctx = {"fns": {}}
+        model, qvars, gen = _engine_lm_fixture()
+    gc.collect()
+
+    chunk = max(1, DEC_PROMPT // 8)
+    while DEC_PROMPT % chunk:
+        chunk -= 1
+    slots = 8
+    n_heavy = 4   # decode-heavy: full DEC_NEW budget
+    n_light = 4   # prefill-heavy: the admission dominates
+    light_new = max(1, DEC_NEW // 16)
+
+    trace = []
+    for i in range(n_heavy + n_light):
+        ids = gen.integers(1, LM_VOCAB, size=DEC_PROMPT).tolist()
+        trace.append((ids, DEC_NEW if i % 2 == 0 else light_new))
+    total_new = sum(n for _, n in trace)
+
+    def make_engine(**kw):
+        return DecodeEngine(
+            model, qvars, prompt_buckets=(DEC_PROMPT,),
+            max_new_cap=DEC_NEW, quant_kernel=True,
+            steps_per_dispatch=8, prefill_chunk=chunk, **kw,
+        )
+
+    # compiled-program pools: the PREFILL family (admission-cache
+    # programs, slot-count independent — see _prefill_fns) is shared
+    # everywhere; dispatch/insert families close over their engine's
+    # self and carry shape, so they only pool across IDENTICAL configs
+    # (the two monolithic replicas)
+    pools: dict = {}
+
+    def adopt(eng, key):
+        pool = pools.setdefault(key, dict(_prefill_fns(ctx["fns"])))
+        eng._fns.update(pool)
+        eng._fns_pool = pool
+        return eng
+
+    def harvest(eng):
+        eng._fns_pool.update(eng._fns)
+        ctx["fns"].update(_prefill_fns(eng._fns))
+        eng.close()
+
+    # ---- split arm: prefill_only -> handoff -> import, full slots
+    pre = adopt(make_engine(prefill_only=True, slots=1,
+                            kv_page_tokens=chunk), "prefill")
+    dec = adopt(make_engine(kv_layout="paged", slots=slots), "dec8")
+    # warm both paths once (compile outside the timed window)
+    w = pre.submit(trace[0][0], 4).result(timeout=600)
+    dec.import_pages(w["handoff"]).result(timeout=600)
+    dec.submit(trace[0][0], 4).result(timeout=600)
+    pre.warm_export_fns()
+    dec.warm_dispatch_fns()
+    dec.warm_fused_fns()
+
+    t0 = time.perf_counter()
+    pre_futs = [pre.submit(ids, n) for ids, n in trace]
+    dec_futs = []
+    handoff_bytes = 0
+    for f in as_completed(pre_futs):
+        blob = f.result(timeout=600)["handoff"]
+        handoff_bytes += len(blob)
+        dec_futs.append(dec.import_pages(blob))
+    for f in dec_futs:
+        f.result(timeout=600)
+    split_wall = time.perf_counter() - t0
+    split_tps = total_new / split_wall
+
+    # bit-exactness probe on THIS config (tokens + logprobs), and the
+    # leak gate at quiesce
+    probe_ids = trace[1][0]
+    r_mono_probe = dec.submit(
+        probe_ids, light_new, logprobs=True
+    ).result(timeout=600)
+    blob = pre.submit(
+        probe_ids, light_new, logprobs=True
+    ).result(timeout=600)["handoff"]
+    r_imp_probe = dec.import_pages(blob).result(timeout=600)
+    bit_exact = (
+        r_imp_probe["ids"] == r_mono_probe["ids"]
+        and r_imp_probe.get("logprobs") == r_mono_probe.get("logprobs")
+    )
+    # quiesce on the POOL's own state: the future resolves inside
+    # _finish a beat before the loop thread releases the slot's
+    # pages, so "my result() returned" does not mean the bookkeeping
+    # settled yet
+    for _ in range(200):
+        pst = dec._pool.stats()
+        if (pst["pages_used"] == pst["pages_reclaimable"]
+                and pst["outstanding_page_leases"] == 0):
+            break
+        time.sleep(0.05)
+    leaked_pages = (
+        pst["pages_total"] - pst["pages_free"] - pst["pages_used"]
+    ) + (pst["pages_used"] - pst["pages_reclaimable"])
+    leaked_leases = pst["outstanding_page_leases"]
+    split_stats = {
+        "handoffs": dec.stats()["handoffs_imported"],
+        "rejects": dec.stats()["handoff_rejects"],
+    }
+    harvest(pre)
+    harvest(dec)
+    gc.collect()
+
+    # ---- monolithic arm: two paged engines, slots/2 each
+    monos = [
+        adopt(make_engine(kv_layout="paged", slots=slots // 2),
+              "mono4")
+        for _ in range(2)
+    ]
+    for m in monos:  # warm BOTH replicas' programs outside the window
+        m.submit(trace[0][0], 4).result(timeout=600)
+        m.warm_dispatch_fns()
+        m.warm_fused_fns()  # mixed traffic fuses chunks onto dispatches
+    t0 = time.perf_counter()
+    futs = [
+        monos[i % 2].submit(ids, n)
+        for i, (ids, n) in enumerate(trace)
+    ]
+    for f in futs:
+        f.result(timeout=600)
+    mono_wall = time.perf_counter() - t0
+    mono_tps = total_new / mono_wall
+    for m in monos:
+        harvest(m)
+    gc.collect()
+
+    print(json.dumps({
+        "metric": "disaggregated_serving_mixed_trace",
+        "value": round(split_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(split_tps / mono_tps, 4),
+        "monolithic_tokens_per_sec": round(mono_tps, 1),
+        "split_tokens_per_sec": round(split_tps, 1),
+        "trace": {
+            "requests": len(trace), "prompt": DEC_PROMPT,
+            "decode_heavy_new": DEC_NEW, "prefill_heavy_new": light_new,
+        },
+        "handoff_bytes_per_request": handoff_bytes // len(trace),
+        "import_bit_exact": bool(bit_exact),
+        "handoffs_imported": split_stats["handoffs"],
+        "handoff_rejects": split_stats["rejects"],
+        "leaked_pages": int(leaked_pages),
+        "leaked_leases": int(leaked_leases),
+    }))
+
+    # ---- router proxy ceiling: keep-alive pool off vs on
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mlcomp_tpu.fleet import Router, make_router_http_server
+
+    canned = json.dumps({"ids": [1, 2, 3], "text": "x"}).encode()
+    hz = json.dumps({
+        "ok": True, "ready": True, "queue_depth": 0, "phase": "both",
+    }).encode()
+
+    class _Stub(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(hz)))
+            self.end_headers()
+            self.wfile.write(hz)
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(canned)))
+            self.end_headers()
+            self.wfile.write(canned)
+
+    stub = ThreadingHTTPServer(("127.0.0.1", 0), _Stub)
+    threading.Thread(target=stub.serve_forever, daemon=True).start()
+    router = Router(
+        urls=[f"http://127.0.0.1:{stub.server_address[1]}"],
+        health_poll_s=60.0,
+    )
+    rhttpd = None
+    try:
+        router.poll_once()
+        rhttpd = make_router_http_server(router, "127.0.0.1", 0)
+        threading.Thread(
+            target=rhttpd.serve_forever, daemon=True
+        ).start()
+        rport = rhttpd.server_address[1]
+        body = json.dumps(
+            {"prompt": [1, 2, 3, 4], "max_new_tokens": 4}
+        ).encode()
+
+        def drive(n):
+            import http.client
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", rport, timeout=30
+            )
+            t0 = time.perf_counter()
+            for _ in range(n):
+                conn.request("POST", "/generate", body=body, headers={
+                    "Content-Type": "application/json",
+                    "Content-Length": str(len(body)),
+                })
+                r = conn.getresponse()
+                r.read()
+            dt = time.perf_counter() - t0
+            conn.close()
+            return n / dt
+
+        drive(20)  # warm both sides of the client connection
+        arms = {}
+        for enabled in (False, True):
+            router.pool.enabled = enabled
+            router.pool.close()  # drop any parked sockets between arms
+            arms["pooled" if enabled else "unpooled"] = statistics.median(
+                drive(100) for _ in range(3)
+            )
+        pool_stats = router.pool.stats()
+        print(json.dumps({
+            "metric": "fleet_router_proxy_rps",
+            "value": round(arms["pooled"], 1),
+            "unit": "req/s",
+            "vs_baseline": round(arms["pooled"] / arms["unpooled"], 4),
+            "unpooled_rps": round(arms["unpooled"], 1),
+            "pooled_rps": round(arms["pooled"], 1),
+            "conn_opens": pool_stats["opens"],
+            "conn_reuses": pool_stats["reuses"],
+        }))
+    finally:
+        if rhttpd is not None:
+            rhttpd.shutdown()
+            rhttpd.server_close()
+        router.close()
+        stub.shutdown()
+        stub.server_close()
+
+
 def main() -> None:
     def on(flag):
         return os.environ.get(flag, "") not in ("1", "true")
@@ -2561,6 +2848,8 @@ def main() -> None:
         ctx = bench_engine(variants)
     if on("MLCOMP_BENCH_SKIP_PREFIX"):
         bench_prefix_cache(ctx)  # reuses the engine line's programs
+    if on("MLCOMP_BENCH_SKIP_DISAGG"):
+        bench_disaggregation(ctx)  # reuses the fixture weights
     if on("MLCOMP_BENCH_SKIP_LONGCTX"):
         bench_longctx()  # last = cheapest to lose to a bench-budget
         # timeout (the earlier lines are already printed)

@@ -429,6 +429,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         metrics_history_interval=args.metrics_history_interval,
         slo_config=slo_config,
         dist=dist,
+        phase=args.phase,
     )
     if args.warmup:
         n = service.warmup()
@@ -474,6 +475,25 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
     max_replicas = args.max_replicas or max(
         args.replicas, args.min_replicas
     )
+    phase_split = None
+    if args.phase_split:
+        if args.scheduler or args.autoscale or args.autoscale_dry_run:
+            print("error: --phase-split does not combine with"
+                  " --scheduler or --autoscale yet (a phase-split"
+                  " fleet runs two fixed replica sets)",
+                  file=sys.stderr)
+            return 2
+        try:
+            n_prefill, n_decode = (
+                int(x) for x in args.phase_split.split(":")
+            )
+            if n_prefill < 1 or n_decode < 1:
+                raise ValueError
+        except ValueError:
+            print(f"error: --phase-split expects P:D with both >= 1,"
+                  f" got {args.phase_split!r}", file=sys.stderr)
+            return 2
+        phase_split = (n_prefill, n_decode)
     if args.scheduler:
         import yaml
 
@@ -509,19 +529,80 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
         )
         port_range = (lo, hi)
     metrics = Registry()
-    manager = ReplicaManager(
-        launcher,
-        ReplicaSpec(
-            target=args.replicas,
-            port_range=port_range,
-            health_poll_s=args.health_poll,
-            restart_budget=args.restart_budget,
-        ),
+    if phase_split is not None:
+        if args.scheduler:
+            raise AssertionError  # rejected above
+        n_prefill, n_decode = phase_split
+        # split the port window between the sets (each manager tracks
+        # its own used ports) and force the role flags AFTER the
+        # user's --serve-arg extras, so argparse last-wins keeps the
+        # sets coherent: prefill daemons run the dense admission core,
+        # decode daemons the paged slot loop
+        mid = lo + (hi - lo) // 2
+
+        def strip_flags(argv, flags):
+            """Drop ``--flag value`` pairs the prefill daemons reject
+            (decode-pool / spec tuning passed via --serve-arg sizes
+            the DECODE half; a prefill_only engine refuses them at
+            construction, which would crash-loop the whole set)."""
+            out, skip = [], False
+            for a in argv:
+                if skip:
+                    skip = False
+                    continue
+                if a in flags:
+                    skip = True
+                    continue
+                out.append(a)
+            return out
+
+        decode_only = ("--kv-pages", "--max-slots", "--engine-spec-k")
+        managers = []
+        for set_name, target, prange, base_argv, extra in (
+            ("prefill", n_prefill, (lo, mid),
+             strip_flags(serve_argv, decode_only),
+             ["--phase", "prefill", "--kv-layout", "dense"]),
+            ("decode", n_decode, (mid + 1, hi), serve_argv,
+             ["--phase", "decode", "--kv-layout", "paged"]),
+        ):
+            managers.append(ReplicaManager(
+                SubprocessLauncher(
+                    base_argv + extra, host=args.host,
+                    log_dir=args.log_dir,
+                ),
+                ReplicaSpec(
+                    target=target,
+                    set_name=set_name,
+                    phase=extra[1],
+                    port_range=prange,
+                    health_poll_s=args.health_poll,
+                    restart_budget=args.restart_budget,
+                ),
+                # the per-set managers would fight over the fleet-wide
+                # replicas_target/live gauges (one unlabeled gauge,
+                # two writers): the ROUTER's live_by_phase gauge is
+                # the per-phase observability surface instead
+                metrics=None,
+                registry_path=registry_path,
+            ))
+    else:
+        managers = [ReplicaManager(
+            launcher,
+            ReplicaSpec(
+                target=args.replicas,
+                port_range=port_range,
+                health_poll_s=args.health_poll,
+                restart_budget=args.restart_budget,
+            ),
+            metrics=metrics,
+            registry_path=registry_path,
+        )]
+    manager = managers[0]
+    router = Router(
+        manager=managers if len(managers) > 1 else manager,
         metrics=metrics,
-        registry_path=registry_path,
+        health_poll_s=min(args.health_poll, 1.0),
     )
-    router = Router(manager=manager, metrics=metrics,
-                    health_poll_s=min(args.health_poll, 1.0))
     scaler = None
     stop = threading.Event()
     threads = []
@@ -550,14 +631,22 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
                     }), flush=True)
 
         threads.append(threading.Thread(target=scale_loop, daemon=True))
-    manager.start()
+    for m in managers:
+        m.start()
     router.start()
     httpd = make_router_http_server(router, args.host, args.port)
     for t in threads:
         t.start()
     print(json.dumps({
         "event": "fleet", "router": f"http://{args.host}:{args.port}",
-        "registry": registry_path, "replicas": args.replicas,
+        "registry": registry_path,
+        "replicas": (
+            sum(phase_split) if phase_split else args.replicas
+        ),
+        "phase_split": (
+            f"{phase_split[0]}:{phase_split[1]}" if phase_split
+            else None
+        ),
         "autoscale": bool(scaler),
         "dry_run": bool(scaler and scaler.dry_run),
     }), flush=True)
@@ -570,7 +659,8 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
         httpd.shutdown()
         httpd.server_close()
         router.close()
-        manager.close(stop_replicas=True)
+        for m in managers:
+            m.close(stop_replicas=True)
         # give subprocess replicas a beat to die before the registry
         # file is left behind as state for the next incarnation
         time.sleep(0.1)
@@ -970,6 +1060,17 @@ def main(argv=None) -> int:
         " rates'.  Malformed config fails startup, not the first"
         " evaluation",
     )
+    sv.add_argument(
+        "--phase", choices=("both", "prefill", "decode"),
+        default="both",
+        help="disaggregated serving role (docs/serving.md"
+        " 'Disaggregated serving'): 'prefill' runs the admission core"
+        " only and answers POST /prefill with KV-page handoff blobs"
+        " (continuous batcher, dense layout); 'decode' is a paged"
+        " daemon that additionally admits handoffs via POST /import,"
+        " skipping prefill with bit-identical tokens; 'both' (default)"
+        " is the monolithic daemon",
+    )
     sv.add_argument("--warmup", action="store_true",
                     help="precompile the hot buckets before listening")
     sv.set_defaults(fn=_cmd_serve)
@@ -1049,6 +1150,17 @@ def main(argv=None) -> int:
     fl.add_argument("--log-dir", default=None,
                     help="per-replica stdout/stderr logs (subprocess"
                     " launcher)")
+    fl.add_argument(
+        "--phase-split", default=None, metavar="P:D",
+        help="run a DISAGGREGATED fleet instead of N monolithic"
+        " replicas: P prefill replicas (admission core only, POST"
+        " /prefill hands back KV-page blobs) and D decode replicas"
+        " (paged daemons admitting POST /import), with the router"
+        " brokering the two-hop handoff per request"
+        " (docs/serving.md 'Disaggregated serving').  Overrides"
+        " --replicas; not combinable with --autoscale or --scheduler"
+        " (named follow-ups)",
+    )
     fl.set_defaults(fn=_cmd_fleet)
 
     args = p.parse_args(argv)

@@ -253,7 +253,7 @@ class _Admission:
     __slots__ = ("req", "s_bucket", "chunk", "n_chunks", "next_chunk",
                  "row", "positions", "kv_mask", "cache", "last_logits",
                  "capture_lo", "skip_capture", "fused_any", "stall_ms",
-                 "page_lease")
+                 "page_lease", "handoff")
 
     def __init__(self, req, s_bucket, chunk, first_chunk):
         self.req = req
@@ -280,6 +280,9 @@ class _Admission:
         self.page_lease = None          # device prefix-registry hit
         # (kvpool.PageLease): pages retained until the insert commits
         # the table row (shared COW mapping) or the admission dies
+        self.handoff = None             # IMPORT admission (decode side
+        # of a disaggregated handoff): the parsed payload — no chunks
+        # run; the completion boundary writes pages + inserts the slot
 
 
 class DecodeEngine:
@@ -320,11 +323,57 @@ class DecodeEngine:
         max_slots: Optional[int] = None,
         k_ladder: Optional[Sequence[int]] = None,
         dist=None,
+        prefill_only: bool = False,
     ):
         import jax
         import jax.numpy as jnp
 
         self.model = model
+        # PREFILL-ONLY mode (disaggregated serving's prefill half): the
+        # engine runs ONLY the admission core — chunked prefill, prefix
+        # cache, capture — and a completed admission EXPORTS the
+        # prompt's KV as page-tile handoff payloads instead of
+        # inserting into a decode slot.  No decode dispatches ever
+        # issue, so the slot carry is forced to one throwaway row and
+        # the fused/pipelined decode machinery stays inert (there is no
+        # decode dispatch for a chunk to ride).  This is the pure
+        # batched-forward shape the BERT/scoring fast path shares.
+        self.prefill_only = bool(prefill_only)
+        if self.prefill_only:
+            if spec_k is not None:
+                raise ValueError(
+                    "prefill_only engines run no decode dispatch; "
+                    "drop spec_k"
+                )
+            if dist is not None:
+                raise ValueError(
+                    "prefill_only does not compose with distributed "
+                    "serving (the gang synchronizes DECODE boundaries); "
+                    "run prefill replicas single-process"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "prefill_only is single-chip for now (the export "
+                    "capture fetches host rows, which does not compose "
+                    "with a sharded admission cache — the sharded "
+                    "prefill tier is a named follow-up); drop the mesh"
+                )
+            if kv_layout != "dense":
+                raise ValueError(
+                    "prefill_only engines keep the dense admission "
+                    "cache (there are no decode slots to page); pass "
+                    "kv_page_tokens to pick the EXPORT page size"
+                )
+            if kv_pages is not None or max_slots is not None:
+                raise ValueError(
+                    "kv_pages / max_slots need a decode slot pool; a "
+                    "prefill_only engine has none"
+                )
+            # one throwaway carry row: the decode state is never
+            # dispatched, so slots would only burn HBM
+            slots = 1
+            pipeline_depth = 1
+            fused_admission = False
         self.slots = int(slots)
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.max_new_cap = int(max_new_cap)
@@ -573,10 +622,12 @@ class DecodeEngine:
                     "the dense layout reserves worst-case KV per slot "
                     "at construction"
                 )
-            if kv_page_tokens is not None or kv_pages is not None:
+            if (kv_page_tokens is not None and not self.prefill_only) \
+                    or kv_pages is not None:
                 raise ValueError(
                     "kv_page_tokens / kv_pages only apply to "
-                    "kv_layout='paged'"
+                    "kv_layout='paged' (kv_page_tokens additionally "
+                    "picks a prefill_only engine's EXPORT page size)"
                 )
         else:
             from mlcomp_tpu.kvpool import (
@@ -590,23 +641,11 @@ class DecodeEngine:
             # pages must tile every chunk so registry-hit boundaries
             # (chunk-quantized, like the host prefix cache's) land on
             # page boundaries — the quantum the page size aligns to
-            widths = set()
-            for s in self.prompt_buckets:
-                c = min(self.prefill_chunk, s)
-                if s % c:
-                    c = s
-                widths.add(c)
-            T = (
-                math.gcd(*widths) if kv_page_tokens is None
-                else int(kv_page_tokens)
+            T = self._page_quantum(
+                kv_page_tokens,
+                "chunk-aligned prefix boundaries must land on page "
+                "boundaries",
             )
-            bad = sorted(c for c in widths if c % T)
-            if bad:
-                raise ValueError(
-                    f"kv_page_tokens={T} must divide every prefill "
-                    f"chunk width (got chunk(s) {bad}): chunk-aligned "
-                    "prefix boundaries must land on page boundaries"
-                )
             cache_abs = jax.eval_shape(
                 lambda: init_cache(self.model, 1, self.l_buf)
             )
@@ -727,6 +766,31 @@ class DecodeEngine:
                 self._paged_attn = "lax"
                 self._kv_fused_kernels = False
 
+        # EXPORT geometry (prefill_only): the page size the handoff
+        # payloads tile to.  Same quantum rule as the paged layout —
+        # pages must tile every prefill chunk so bucket boundaries are
+        # page boundaries (every bucket is a whole number of chunks,
+        # so s_bucket lands page-aligned and the prompt span exports
+        # as whole tiles) — and the leaf inventory is the admission
+        # cache's, recorded once so every export shares it.
+        self._export_T: Optional[int] = None
+        self._export_leaves = None
+        if self.prefill_only:
+            from mlcomp_tpu.cache.kv_store import kv_leaf_items
+            from mlcomp_tpu.models.generation import init_cache
+
+            self._export_T = self._page_quantum(
+                kv_page_tokens,
+                "handoff pages must tile the admission geometry",
+            )
+            cache_abs = jax.eval_shape(
+                lambda: init_cache(self.model, 1, self.l_buf)
+            )
+            self._export_leaves = [
+                (keystr, axis, tuple(leaf.shape), leaf.dtype)
+                for keystr, axis, leaf in kv_leaf_items(cache_abs)
+            ]
+
         # weight prep mirrors generate(): entry-dequant everything the
         # kernel won't consume, fold the rest — ONCE, outside any step
         from mlcomp_tpu.ops.quant import (
@@ -823,6 +887,20 @@ class DecodeEngine:
             self._stats["kv_registry_hit_tokens"] = 0
             self._stats["kv_pages_lazy_allocated"] = 0
             self._stats["kv_decode_page_failures"] = 0
+            # disaggregation, decode side: handoffs imported via
+            # import_pages (pages written straight into the pool, no
+            # prefill), bytes received, and typed rejects (truncated/
+            # mismatched blobs — a prefill replica dying mid-transfer)
+            self._stats["handoffs_imported"] = 0
+            self._stats["kv_pages_imported"] = 0
+            self._stats["handoff_bytes_imported"] = 0
+            self._stats["handoff_rejects"] = 0
+        if self.prefill_only:
+            # disaggregation, prefill side: completed admissions
+            # exported as page-payload handoffs
+            self._stats["handoffs_exported"] = 0
+            self._stats["kv_pages_exported"] = 0
+            self._stats["handoff_bytes_exported"] = 0
         self._spec_warned = False
         # sticky spec-honesty verdict: flips True (and stays) when
         # measured acceptance is <= 1.0 past the 64-row window — the
@@ -1176,6 +1254,12 @@ class DecodeEngine:
                 "a speculative engine (spec_k set) is greedy-only: "
                 "temperature must be 0 and repetition_penalty 1"
             )
+        if self.prefill_only and stream is not None:
+            raise ValueError(
+                "a prefill_only engine emits no tokens to stream: the "
+                "future resolves with the handoff payload (decode — "
+                "and stream — on a decode replica via import_pages)"
+            )
         if self._stop.is_set():
             # a submit racing close() must fail HERE — after close's
             # queue drain nobody reads the queue, so an enqueued request
@@ -1252,6 +1336,205 @@ class DecodeEngine:
             # service-visible request count means real requests only
             # graftcheck: ignore[unguarded-write] -- GIL-atomic int add; the sole off-loop writer, and the only writer of this key
             self._stats["requests"] += 1
+        return fut
+
+    def validate_handoff(self, blob: bytes):
+        """Parse + geometry-validate a handoff blob against THIS
+        engine's paged layout — every violation raises the typed
+        :class:`~mlcomp_tpu.kvpool.transfer.HandoffError` BEFORE any
+        page, lease, or slot is touched (the partial-transfer
+        contract, chaoscheck scenario 10).  Returns the parsed
+        ``(meta, last_logits, payloads)`` for :meth:`import_pages`."""
+        from mlcomp_tpu.kvpool.transfer import HandoffError
+
+        if self._pool is None:
+            raise ValueError(
+                "import_pages needs kv_layout='paged': the handoff's "
+                "currency is pages in this engine's PagePool"
+            )
+        try:
+            return self._validate_handoff(blob)
+        except HandoffError:
+            # typed-reject accounting, wherever the validation ran
+            # (HTTP thread or a direct import_pages call)
+            # graftcheck: ignore[unguarded-write] -- GIL-atomic int add; off-loop reject accounting, sole writer of this key
+            self._stats["handoff_rejects"] += 1
+            raise
+
+    def _validate_handoff(self, blob: bytes):
+        from mlcomp_tpu.kvpool.transfer import HandoffError, decode_handoff
+
+        meta, logits, payloads = decode_handoff(blob)
+        pool, layout = self._pool, self._layout
+        T = int(meta.get("page_tokens") or 0)
+        if T != pool.page_tokens:
+            raise HandoffError(
+                f"handoff pages hold {T} tokens; this pool's hold "
+                f"{pool.page_tokens} — prefill and decode replicas "
+                "must share the page quantum (kv_page_tokens)"
+            )
+        try:
+            ids = [int(t) for t in meta["ids"]]
+            s_bucket = int(meta["s_bucket"])
+            start_pad = int(meta["start_pad"])
+            n_new = int(meta["n_new"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise HandoffError(f"bad handoff metadata: {e}") from None
+        if not ids or n_new <= 0:
+            raise HandoffError("handoff carries no prompt or no budget")
+        if n_new > self.max_new_cap:
+            raise HandoffError(
+                f"handoff max_new_tokens {n_new} exceeds this engine's "
+                f"cap {self.max_new_cap}"
+            )
+        try:
+            want_bucket = self._bucket(len(ids))
+        except ValueError as e:
+            # a prompt past this engine's largest bucket is the same
+            # shared-geometry violation, rejected TYPED like the rest
+            raise HandoffError(
+                f"handoff prompt does not fit this engine's buckets: "
+                f"{e} — prefill and decode replicas must share prompt "
+                "buckets"
+            ) from None
+        if s_bucket != want_bucket or (
+            start_pad != s_bucket - len(ids)
+        ):
+            raise HandoffError(
+                f"handoff placement (s_bucket={s_bucket}, "
+                f"start_pad={start_pad}) does not match this engine's "
+                f"bucket for a {len(ids)}-token prompt — prefill and "
+                "decode replicas must share prompt buckets"
+            )
+        if s_bucket % T:
+            raise HandoffError(
+                f"s_bucket={s_bucket} is not page-aligned at T={T}"
+            )
+        n_pages = s_bucket // T - start_pad // T
+        leaves = meta.get("leaves")
+        if not isinstance(leaves, list) or len(leaves) != len(
+            layout.kv_specs
+        ) or len(payloads) != len(layout.kv_specs):
+            raise HandoffError(
+                f"handoff carries {len(payloads)} KV leaves; this "
+                f"engine's cache has {len(layout.kv_specs)}"
+            )
+        for lv, spec, pl in zip(leaves, layout.kv_specs, payloads):
+            want = (n_pages,) + layout._page_rest(spec)
+            if lv.get("key") != spec.keystr:
+                raise HandoffError(
+                    f"handoff leaf {lv.get('key')!r} does not match "
+                    f"this engine's {spec.keystr!r} (different model "
+                    "or cache family)"
+                )
+            if tuple(pl.shape) != want or pl.dtype != np.dtype(
+                spec.dtype
+            ):
+                raise HandoffError(
+                    f"handoff leaf {spec.keystr}: payload "
+                    f"{pl.dtype}{tuple(pl.shape)} vs expected "
+                    f"{np.dtype(spec.dtype)}{want}"
+                )
+        if tuple(logits.shape) != (1, self.vocab):
+            raise HandoffError(
+                f"handoff logits shaped {tuple(logits.shape)}; this "
+                f"engine's vocab row is (1, {self.vocab})"
+            )
+        return meta, logits, payloads
+
+    def import_pages(
+        self,
+        blob: bytes,
+        stream: Optional["queue.Queue"] = None,
+        deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
+        parsed=None,
+    ) -> Future:
+        """Admit a request by IMPORTING its finished prefill — the
+        decode half of a disaggregated handoff.  The payload pages are
+        written straight into the PagePool at the admission boundary
+        (registry-registered, ref-counted) and the slot starts
+        decoding at the prompt's end; no prefill chunk ever runs, and
+        the emitted tokens are bit-identical to a local admission of
+        the same prompt (same KV bytes, same final logits, same
+        per-request sampling stream).
+
+        Validation happens HERE, on the caller thread: a truncated or
+        geometry-mismatched blob raises the typed ``HandoffError``
+        with zero pages/leases touched.  ``parsed`` (the tuple
+        :meth:`validate_handoff` returned) skips a second parse when
+        the HTTP layer already validated."""
+        if self._dist is not None:
+            raise RuntimeError(
+                "import_pages does not compose with distributed "
+                "serving yet (imports are not broadcast to the gang) "
+                "— the named follow-up"
+            )
+        meta, logits, payloads = (
+            parsed if parsed is not None
+            else self.validate_handoff(blob)
+        )
+        if self._stop.is_set():
+            raise RuntimeError("decode engine closed")
+        if self._broken is not None:
+            raise RuntimeError(
+                f"decode engine is down: {self._broken!r}"
+            ) from self._broken
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError(
+                f"deadline_s must be positive, got {deadline_s}"
+            )
+        knobs = dict(meta.get("req") or {})
+        if trace_id is None:
+            trace_id = meta.get("trace_id")
+        if trace_id is None or not valid_trace_id(trace_id):
+            trace_id = make_trace_id()
+        fut: Future = Future()
+        rid = next(self._rid)
+        fut.rid = rid
+        fut.trace_id = trace_id
+        self.recorder.async_begin(
+            "request", rid, cat="req", prompt=len(meta["ids"]),
+            n_new=int(meta["n_new"]), trace_id=trace_id, imported=True,
+        )
+        now = time.perf_counter()
+        self._queue.put({
+            "ids": [int(t) for t in meta["ids"]],
+            "n_new": int(meta["n_new"]), "future": fut,
+            "temperature": float(knobs.get("temperature", 0.0)),
+            "top_k": int(knobs.get("top_k") or self.vocab),
+            "top_p": float(knobs.get("top_p") or 1.0),
+            "eos_id": int(
+                knobs.get("eos_id") if knobs.get("eos_id") is not None
+                else -1
+            ),
+            "logprobs": bool(knobs.get("logprobs", False)),
+            "repetition_penalty": float(
+                knobs.get("repetition_penalty", 1.0)
+            ),
+            "stream": stream,
+            "t_submit": now,
+            "t_deadline": (
+                None if deadline_s is None else now + float(deadline_s)
+            ),
+            "rid": rid,
+            "trace_id": trace_id,
+            "warmup": False,
+            # the parsed handoff rides the request into the loop; the
+            # completion boundary writes the pages and inserts the slot
+            "handoff": {
+                "meta": meta, "logits": logits, "payloads": payloads,
+                "bytes": len(blob) if blob is not None else 0,
+            },
+        })
+        if self._stop.is_set() or self._broken is not None:
+            if stream is not None:
+                stream.put(None)
+            _fail_future(fut, self._broken or RuntimeError(
+                "decode engine closed"
+            ))
+        # graftcheck: ignore[unguarded-write] -- GIL-atomic int add; same off-loop requests accounting as submit()
+        self._stats["requests"] += 1
         return fut
 
     def cancel(self, rid: int) -> bool:
@@ -1719,6 +2002,31 @@ class DecodeEngine:
                 "Requests failed mid-decode by a dry page pool at a "
                 "lazy page crossing (bounded failure)",
                 st["kv_decode_page_failures"])
+            ctr("mlcomp_engine_handoffs_imported_total",
+                "Disaggregated handoffs admitted via import_pages "
+                "(prefill skipped; payload pages written straight "
+                "into the pool)", st["handoffs_imported"])
+            ctr("mlcomp_engine_kv_pages_imported_total",
+                "KV pages received through handoff imports",
+                st["kv_pages_imported"])
+            ctr("mlcomp_engine_handoff_bytes_imported_total",
+                "Handoff payload bytes received (wire size of "
+                "accepted imports)", st["handoff_bytes_imported"])
+            ctr("mlcomp_engine_handoff_rejects_total",
+                "Handoff blobs rejected typed before any allocation "
+                "(truncated transfer, geometry mismatch)",
+                st["handoff_rejects"])
+        if self.prefill_only:
+            ctr("mlcomp_engine_handoffs_exported_total",
+                "Completed admissions exported as page-payload "
+                "handoffs (prefill-only engines)",
+                st["handoffs_exported"])
+            ctr("mlcomp_engine_kv_pages_exported_total",
+                "KV pages serialized into exported handoffs",
+                st["kv_pages_exported"])
+            ctr("mlcomp_engine_handoff_bytes_exported_total",
+                "Handoff payload bytes serialized (wire size of "
+                "exports)", st["handoff_bytes_exported"])
         gau("mlcomp_engine_kv_bytes_moved_per_dispatch",
             "Estimated KV bytes one dispatch moves through HBM "
             "(dense: K forwards x buffer; paged fused: K forwards x "
@@ -1867,6 +2175,35 @@ class DecodeEngine:
 
         return _bucket(n, self.prompt_buckets, "prompt length")
 
+    def _chunk_width(self, s_bucket: int) -> int:
+        """The admission chunk width for a bucket: the configured
+        ``prefill_chunk`` when it divides the bucket, else one
+        monolithic chunk (odd buckets) — the ONE place this fallback
+        rule lives (admission start, warmup, and both page-quantum
+        derivations all read it)."""
+        c = min(self.prefill_chunk, s_bucket)
+        return s_bucket if s_bucket % c else c
+
+    def _page_quantum(self, kv_page_tokens, why: str) -> int:
+        """The page size the admission geometry admits: the gcd of
+        every bucket's chunk width when ``kv_page_tokens`` is unset,
+        else the explicit value validated to tile every chunk.  Both
+        the paged decode pool and a prefill_only engine's EXPORT pages
+        derive through here, so phase-split replicas launched from the
+        same serve flags agree on the quantum by construction."""
+        widths = {self._chunk_width(s) for s in self.prompt_buckets}
+        T = (
+            math.gcd(*widths) if kv_page_tokens is None
+            else int(kv_page_tokens)
+        )
+        bad = sorted(c for c in widths if c % T)
+        if bad:
+            raise ValueError(
+                f"kv_page_tokens={T} must divide every prefill chunk "
+                f"width (got chunk(s) {bad}): {why}"
+            )
+        return T
+
     def _apply(self, *args, **kwargs):
         if self.quant_kernel:
             from mlcomp_tpu.ops.quant import quant_kernel_interception
@@ -1975,9 +2312,7 @@ class DecodeEngine:
         items = kv_leaf_items(cache)
         n = 0
         for s in self.prompt_buckets:
-            c = min(self.prefill_chunk, s)
-            if s % c:
-                c = s  # the odd-bucket monolithic fallback
+            c = self._chunk_width(s)
             for k in range(s // c):
                 self._capture_fn(k * c, s)(cache)
                 n += 1
@@ -1992,6 +2327,30 @@ class DecodeEngine:
                 n += 1
         return n
 
+    def warm_export_fns(self) -> int:
+        """Precompile the export capture programs (prefill-only
+        service warmup): one chunk-aligned capture slice per possible
+        pad placement per bucket.  Cheap like the prefix-cache
+        programs — zeros-init + slice, never a model trace — so the
+        first real handoff mid-serving pays no compile stall."""
+        if not self.prefill_only:
+            return 0
+        if self.prefix_cache is not None:
+            # warm_prefix_fns already ran the identical capture-warm
+            # loop (the export reuses the cache's capture programs) —
+            # don't execute every program a second time
+            return 0
+        from mlcomp_tpu.models.generation import init_cache
+
+        cache = init_cache(self.model, 1, self.l_buf)
+        n = 0
+        for s in self.prompt_buckets:
+            c = self._chunk_width(s)
+            for k in range(s // c):
+                self._capture_fn(k * c, s)(cache)
+                n += 1
+        return n
+
     def warm_dispatch_fns(self) -> int:
         """Precompile the K LADDER's plain dispatch programs (service
         warmup): one compile per rung on an adaptive engine, so a
@@ -1999,6 +2358,8 @@ class DecodeEngine:
         loop-thread compile stall.  Pinned engines warm their one K.
         Runs on THROWAWAY carries — the donated input is a fresh
         ``_fresh_dstate`` the drive loop never owned."""
+        if self.prefill_only:
+            return 0  # no decode dispatch ever issues
         n = 0
         for k in self.k_ladder:
             if ("dispatch", k) in self._fns and k in self._dispatch_warmed:
@@ -2021,12 +2382,7 @@ class DecodeEngine:
         if not self.fused_admission:
             return 0
         jnp = self._jnp
-        widths = set()
-        for s in self.prompt_buckets:
-            c = min(self.prefill_chunk, s)
-            if s % c:
-                c = s  # the odd-bucket monolithic fallback
-            widths.add(c)
+        widths = {self._chunk_width(s) for s in self.prompt_buckets}
         n = 0
         for c in sorted(widths):
             for k in self.k_ladder:
@@ -2962,15 +3318,35 @@ class DecodeEngine:
 
     def _start_admission(self, req) -> None:  # graftcheck: runs-on(loop)
         """Begin a chunked prefill for ``req`` (a free slot exists —
-        checked by the caller; slots only free up while it runs)."""
+        checked by the caller; slots only free up while it runs).
+
+        An IMPORT request (``req["handoff"]``, the decode half of a
+        disaggregated handoff) skips the whole prefill core: its KV
+        already exists as page payloads, so the admission is born
+        complete (``next_chunk == n_chunks``) and the loop's
+        completion boundary — drained pipeline, fresh slot view, the
+        same one-insert stall bound — writes the pages and inserts
+        the slot."""
         from mlcomp_tpu.serve import left_pad_row
 
         jnp = self._jnp
         ids = req["ids"]
         s_bucket = self._bucket(len(ids))
-        c = min(self.prefill_chunk, s_bucket)
-        if s_bucket % c:
-            c = s_bucket  # odd bucket: fall back to one monolithic chunk
+        if req.get("handoff") is not None:
+            adm = _Admission(
+                req, s_bucket, self._chunk_width(s_bucket), 0
+            )
+            adm.next_chunk = adm.n_chunks  # nothing to prefill
+            adm.handoff = req["handoff"]
+            if req.get("rid"):
+                self.recorder.async_instant(
+                    "admit", req["rid"], cat="req", bucket=s_bucket,
+                    imported=True, trace_id=req.get("trace_id"),
+                )
+            req["cache_hit_tokens"] = 0
+            self._adm = adm
+            return
+        c = self._chunk_width(s_bucket)
         start_pad = s_bucket - len(ids)
         first_chunk = start_pad // c  # all-pad chunks before are skipped
         adm = _Admission(req, s_bucket, c, first_chunk)
@@ -3601,7 +3977,12 @@ class DecodeEngine:
         t0 = time.perf_counter()
         self._busy_since = t0
         try:
-            self._insert_admission(jnp, adm, req, s_bucket)
+            if adm.handoff is not None:
+                self._insert_import(jnp, adm, req, s_bucket)
+            elif self.prefill_only:
+                self._export_admission(adm)
+            else:
+                self._insert_admission(jnp, adm, req, s_bucket)
         finally:
             self._busy_since = None
         if decoding:
@@ -3751,6 +4132,239 @@ class DecodeEngine:
             sl.alloc_upto = -(-self._alloc_end(s_bucket, span_end)
                               // T) * T
         self._host[slot] = sl
+
+    # --------------------------------------------- disaggregated handoff
+
+    def _export_admission(self, adm) -> None:  # graftcheck: runs-on(loop)
+        """Prefill-only completion: capture the finished prompt's KV
+        rows (the prefix cache's device->host capture programs, chunk-
+        aligned), tile them into page payloads, and resolve the
+        request's future with the serialized handoff — the prompt is
+        now a transferable object a decode replica imports with
+        :meth:`import_pages`.  Faults here are admission-scoped (the
+        caller's except fails only this request); the
+        ``engine.export`` chaos point models a replica dying
+        mid-transfer."""
+        from mlcomp_tpu.kvpool.transfer import (
+            encode_handoff,
+            rows_to_page_tiles,
+        )
+
+        req = adm.req
+        ids = req["ids"]
+        s_bucket = adm.s_bucket
+        T = self._export_T
+        start_pad = s_bucket - len(ids)
+        if (self.prefix_cache is not None and not req.get("warmup")
+                and not adm.skip_capture):
+            # same best-effort capture enqueue as the insert path: a
+            # prefill replica is WHERE the prefix cache earns its RAM
+            # (every request is an admission), so the finished rows
+            # feed the trie exactly as a monolithic prefill's would
+            try:
+                self.prefix_cache.bind_layout(adm.cache)
+                self.prefix_cache.insert_async(
+                    self._capture_fn(adm.capture_lo, s_bucket),
+                    adm.cache, ids, start_pad, adm.capture_lo,
+                )
+            except Exception:
+                self._stats["cache_degraded"] += 1
+        lo_page = (start_pad // T) * T
+        c = adm.chunk
+        lo_chunk = (start_pad // c) * c  # the warm capture programs
+        # are chunk-keyed; rows below lo_page are sliced off host-side
+        rid = req.get("rid", 0)
+        _inject_fault("engine.export")
+        with self.recorder.span(
+            "handoff_export", track="engine.loop", rid=rid,
+            trace_id=req.get("trace_id"), prompt=len(ids),
+        ) as sp:
+            rows = self._capture_fn(lo_chunk, s_bucket)(adm.cache)
+            off = lo_page - lo_chunk
+            payloads = []
+            for (keystr, axis, _shape, _dt), r in zip(
+                self._export_leaves, rows
+            ):
+                a = np.asarray(r)
+                idx = [slice(None)] * a.ndim
+                idx[axis] = slice(off, s_bucket - lo_chunk)
+                payloads.append(
+                    rows_to_page_tiles(a[tuple(idx)], axis, T)
+                )
+            logits = np.asarray(adm.last_logits, np.float32)
+            meta = {
+                "s_bucket": s_bucket, "start_pad": start_pad,
+                "page_tokens": T,
+                "n_pages": (s_bucket - lo_page) // T,
+                "ids": [int(t) for t in ids],
+                "n_new": int(req["n_new"]),
+                # the per-request sampling-stream seed: carried so a
+                # SAMPLED request's tokens stay reproducible on a
+                # decode engine built with the same seed (greedy never
+                # reads it) — same wrap as the local insert's packed row
+                "rseed": rid % (1 << 23),
+                "trace_id": req.get("trace_id"),
+                "req": {
+                    "temperature": req["temperature"],
+                    "top_k": req["top_k"], "top_p": req["top_p"],
+                    "eos_id": req["eos_id"],
+                    "logprobs": req["logprobs"],
+                    "repetition_penalty": req["repetition_penalty"],
+                },
+                "leaves": [
+                    {"key": keystr}
+                    for keystr, _ax, _sh, _dt in self._export_leaves
+                ],
+            }
+            blob = encode_handoff(meta, logits, payloads)
+            sp["pages"] = meta["n_pages"]
+            sp["bytes"] = len(blob)
+        if not req.get("warmup"):
+            self._stats["handoffs_exported"] += 1
+            self._stats["kv_pages_exported"] += meta["n_pages"]
+            self._stats["handoff_bytes_exported"] += len(blob)
+        now = time.perf_counter()
+        if not req.get("warmup"):
+            # the handoff wall IS this request's service time on the
+            # prefill replica: feed the TTFT reservoir so the replica's
+            # latency percentiles (and SLOs) mean prefill latency
+            ttft_ms = (now - req["t_submit"]) * 1e3
+            self._lat_ttft.append(ttft_ms)
+            self._lat_ttft_n += 1
+            self._hist_ttft.observe(ttft_ms)
+        if rid:
+            self._cancelled.discard(rid)
+            self.recorder.async_end(
+                "request", rid, cat="req", exported=True,
+            )
+        _set_result(req["future"], {
+            "handoff": blob,
+            "prefill_tokens": len(ids),
+            "pages": meta["n_pages"],
+            "cache_hit_tokens": int(req.get("cache_hit_tokens", 0)),
+            "latency_ms": round((now - req["t_submit"]) * 1e3, 2),
+            "trace_id": req.get("trace_id"),
+        })
+
+    def _import_write_fn(self, n_pages: int):
+        """Write one handoff's payload tiles into the page arrays at
+        ``page_ids`` — the device half of :meth:`import_pages`.  One
+        program per distinct prompt-page count (bounded by pages per
+        bucket); composes on the donated carry after the insert."""
+        key = ("import_write", n_pages)
+        if key not in self._fns:
+            def write(dstate, page_ids, *payload):
+                out = dict(dstate)
+                out["pages"] = [
+                    pg.at[page_ids].set(pl)
+                    for pg, pl in zip(dstate["pages"], payload)
+                ]
+                return self._constrain_carry(out)
+
+            self._fns[key] = self._jax.jit(write, donate_argnums=(0,))
+        return self._fns[key]
+
+    def _insert_import(self, jnp, adm, req, s_bucket) -> None:  # graftcheck: runs-on(loop)
+        """Insert an IMPORTED prefill at a free slot: allocate the
+        slot's pages (prompt span + one dispatch of decode lookahead,
+        the same lazy-allocation currency a local insert uses), zero
+        the decode-span pages through the regular insert program, then
+        write the payload tiles into the prompt pages and register
+        them under the placement key — the next same-placement shared
+        prefix maps the IMPORTED pages copy-on-write, exactly as if
+        this replica had prefilled them itself.  A dry pool here is
+        the admission-scoped typed failure (``NoFreePages``), with the
+        same reclaim-then-retry the local insert runs; nothing leaks
+        on any failure path (the uncommitted row is released)."""
+        from mlcomp_tpu.kvpool import GRAVE_PAGE, NoFreePages
+
+        hd = adm.handoff
+        meta = hd["meta"]
+        pool = self._pool
+        T = pool.page_tokens
+        ids = req["ids"]
+        slot = self._host.index(None)
+        start_pad, span_end = self._slot_span(
+            s_bucket, len(ids), req["n_new"]
+        )
+        alloc_end = self._alloc_end(s_bucket, span_end)
+        try:
+            prow, pmask, _forks = pool.build_slot_row(
+                start_pad, span_end, alloc_end=alloc_end,
+            )
+        except NoFreePages:
+            pool.reclaim(pool.private_pages_needed(
+                start_pad, span_end, alloc_end=alloc_end,
+            ))
+            prow, pmask, _forks = pool.build_slot_row(
+                start_pad, span_end, alloc_end=alloc_end,
+            )
+        p0, p_n = start_pad // T, s_bucket // T
+        # write routing: decode-span private pages zero-fill from the
+        # fresh (all-zero) admission cache — a recycled page must not
+        # leak a previous stream's bytes into the masked-but-readable
+        # span — while the prompt pages route to the graveyard here
+        # (the payload write below is what fills them)
+        wsel = np.where(pmask, prow, GRAVE_PAGE).astype(np.int32)
+        wsel[p0:p_n] = GRAVE_PAGE
+        row_presence = np.zeros((1, self.vocab), bool)
+        if req["repetition_penalty"] != 1.0:
+            row_presence[0, np.asarray(ids)] = True
+        packed = np.asarray([
+            slot, s_bucket, len(ids), start_pad,
+            req["n_new"], req["eos_id"], req["temperature"],
+            req["top_k"], req["top_p"], req["repetition_penalty"],
+            # the PREFILL side's sampling-stream seed, not a local
+            # rid: sampled tokens must not depend on which replica
+            # admitted the prompt
+            int(meta.get("rseed", 0)) % (1 << 23),
+            len(ids),
+        ], np.float32)
+        extra = (self._dev(prow), self._dev(wsel))
+        if self.spec_k is not None:
+            ids_np = np.zeros((1, self.t_ids), np.int32)
+            ids_np[0, : len(ids)] = ids
+            extra = extra + (self._dev(ids_np),)
+        n_pages = p_n - p0
+        try:
+            with self.recorder.span(
+                "import", track="engine.loop", slot=slot,
+                rid=req.get("rid", 0), pages=n_pages,
+                trace_id=req.get("trace_id"),
+            ):
+                zeros = self._prefill_init_fn()(self._dev(0, np.int32))
+                self._dstate = self._insert_fn()(
+                    self._dstate, zeros,
+                    self._dev(hd["logits"], np.float32),
+                    self._dev(row_presence), self._dev(packed), *extra,
+                )
+                self._dstate = self._import_write_fn(n_pages)(
+                    self._dstate,
+                    self._dev(prow[p0:p_n], np.int32),
+                    *[self._dev(p) for p in hd["payloads"]],
+                )
+        except Exception:
+            pool.release_row(prow)
+            raise
+        try:
+            pool.commit_slot_row(slot, prow)
+            if not req.get("warmup"):
+                pool.registry_register(s_bucket, start_pad, ids, prow)
+        finally:
+            adm.handoff = None  # drop the payload buffers
+        sl = _Slot(
+            req,
+            cursor=s_bucket,
+            position=len(ids),
+            start=start_pad,
+            remaining=req["n_new"],
+        )
+        sl.span_end = span_end
+        sl.alloc_upto = -(-alloc_end // T) * T
+        self._host[slot] = sl
+        self._stats["handoffs_imported"] += 1
+        self._stats["kv_pages_imported"] += n_pages
+        self._stats["handoff_bytes_imported"] += int(hd.get("bytes", 0))
 
     def _finish(self, slot_idx: int, error: Optional[Exception] = None):  # graftcheck: runs-on(loop)
         sl = self._host[slot_idx]
@@ -4360,6 +4974,91 @@ class DecodeEngine:
 
     # -------------------------------------------------------- drive loop
 
+    def _admission_tick(self) -> bool:  # graftcheck: runs-on(loop)
+        """The PREFILL CORE's per-boundary work, extracted from the
+        drive loop so it runs with or without a decode fleet: start
+        the next admittable request, retire a cancelled/expired
+        admission, advance one prefill chunk (fused onto this
+        boundary's decode dispatch when rows are decoding, staged
+        otherwise), and complete — insert, EXPORT (prefill-only
+        engines), or IMPORT (handoff admissions, which are born
+        complete).  A ``prefill_only`` engine's loop runs ONLY this:
+        with no rows ever active, chunks run staged, nothing fuses,
+        and the decode legs of the loop stay inert.  Returns True when
+        a fused chunk issued this boundary's dispatch."""
+        if (self._adm is None and None in self._host
+                and self._pending):
+            # STAGED join drain only: fused admissions start
+            # against their own fresh cache, and the host slot
+            # view can only UNDER-report free slots, so no
+            # drain is needed to begin one.  FINISH boundaries
+            # never need a drain either way: the device
+            # retires rows itself, so an in-flight dispatch on
+            # a finished row emits nothing — the host just
+            # learns one boundary later.  The paged layout may
+            # DEFER the head (free-page budget) — see
+            # _pop_admittable.
+            req = self._pop_admittable()
+            if req is not None:
+                if not self.fused_admission:
+                    self._drain_inflight()
+                try:
+                    self._start_admission(req)
+                except Exception as e:
+                    self._fail_queued(req, e)
+        if self._adm is not None and self._dist is None:
+            # a cancel/deadline landing mid-prefill retires the
+            # admission between its chunks.  Distributed gangs
+            # retire ONLY at the broadcast boundary (a local
+            # time re-check here would diverge the gang's
+            # device sequence)
+            err = self._retire_check(self._adm.req)
+            if err is not None:
+                self._count_retire(err, self._adm.req)
+                self._fail_admission(err)
+        issued = False
+        adm = self._adm
+        if adm is not None and adm.next_chunk < adm.n_chunks:
+            if self.fused_admission and any(
+                s is not None for s in self._host
+            ):
+                # FUSED: this boundary's dispatch runs the K
+                # decode steps AND the admission's next chunk
+                # as one donated program.  Host-side prep
+                # faults (incl. the engine.fused_prefill chaos
+                # point) are admission-scoped: the fleet falls
+                # through to a plain dispatch below.
+                try:
+                    prep = self._prep_fused_chunk(adm)
+                except Exception as e:
+                    self._fail_admission(e)
+                else:
+                    self._issue_dispatch(fused=(adm, *prep))
+                    issued = True
+            else:
+                # STAGED chunk on a drained pipeline (the
+                # bisect mode — and with no rows decoding
+                # there is no dispatch to ride anyway)
+                self._drain_inflight()
+                try:
+                    self._run_admission_chunk()
+                except Exception as e:
+                    self._fail_admission(e)
+        adm = self._adm
+        if adm is not None and adm.next_chunk >= adm.n_chunks:
+            # all chunks issued (the last may still be in
+            # flight inside a fused dispatch): drain at LOOP
+            # level — a dispatch failure here is the FLEET's
+            # error, never the joiner's — then the one
+            # remaining synchronous boundary, whose insert/
+            # export/import faults are admission-scoped
+            self._drain_inflight()
+            try:
+                self._complete_admission()
+            except Exception as e:
+                self._fail_admission(e)
+        return issued
+
     def _loop_body(self) -> None:  # graftcheck: runs-on(loop)
         while not (self._stop.is_set() or self._exit_loop.is_set()):
             if self._broken is not None:
@@ -4407,77 +5106,7 @@ class DecodeEngine:
                     # head request fits the page budget, shrink to the
                     # floor at quiesce
                     self._elastic_tick()
-                if (self._adm is None and None in self._host
-                        and self._pending):
-                    # STAGED join drain only: fused admissions start
-                    # against their own fresh cache, and the host slot
-                    # view can only UNDER-report free slots, so no
-                    # drain is needed to begin one.  FINISH boundaries
-                    # never need a drain either way: the device
-                    # retires rows itself, so an in-flight dispatch on
-                    # a finished row emits nothing — the host just
-                    # learns one boundary later.  The paged layout may
-                    # DEFER the head (free-page budget) — see
-                    # _pop_admittable.
-                    req = self._pop_admittable()
-                    if req is not None:
-                        if not self.fused_admission:
-                            self._drain_inflight()
-                        try:
-                            self._start_admission(req)
-                        except Exception as e:
-                            self._fail_queued(req, e)
-                if self._adm is not None and self._dist is None:
-                    # a cancel/deadline landing mid-prefill retires the
-                    # admission between its chunks.  Distributed gangs
-                    # retire ONLY at the broadcast boundary (a local
-                    # time re-check here would diverge the gang's
-                    # device sequence)
-                    err = self._retire_check(self._adm.req)
-                    if err is not None:
-                        self._count_retire(err, self._adm.req)
-                        self._fail_admission(err)
-                issued = False
-                adm = self._adm
-                if adm is not None and adm.next_chunk < adm.n_chunks:
-                    if self.fused_admission and any(
-                        s is not None for s in self._host
-                    ):
-                        # FUSED: this boundary's dispatch runs the K
-                        # decode steps AND the admission's next chunk
-                        # as one donated program.  Host-side prep
-                        # faults (incl. the engine.fused_prefill chaos
-                        # point) are admission-scoped: the fleet falls
-                        # through to a plain dispatch below.
-                        try:
-                            prep = self._prep_fused_chunk(adm)
-                        except Exception as e:
-                            self._fail_admission(e)
-                        else:
-                            self._issue_dispatch(fused=(adm, *prep))
-                            issued = True
-                    else:
-                        # STAGED chunk on a drained pipeline (the
-                        # bisect mode — and with no rows decoding
-                        # there is no dispatch to ride anyway)
-                        self._drain_inflight()
-                        try:
-                            self._run_admission_chunk()
-                        except Exception as e:
-                            self._fail_admission(e)
-                adm = self._adm
-                if adm is not None and adm.next_chunk >= adm.n_chunks:
-                    # all chunks issued (the last may still be in
-                    # flight inside a fused dispatch): drain at LOOP
-                    # level — a dispatch failure here is the FLEET's
-                    # error, never the joiner's — then the one
-                    # remaining synchronous boundary, whose insert
-                    # faults are admission-scoped
-                    self._drain_inflight()
-                    try:
-                        self._complete_admission()
-                    except Exception as e:
-                        self._fail_admission(e)
+                issued = self._admission_tick()
                 if not issued and any(s is not None for s in self._host):
                     self._issue_dispatch()
                     issued = True

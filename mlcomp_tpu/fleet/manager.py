@@ -96,6 +96,12 @@ class ReplicaSpec:
 
     target: int = 1
     set_name: str = "fleet"
+    # disaggregation role every replica in this set serves: "both" is
+    # the monolithic daemon; a PHASE-SPLIT fleet runs one manager per
+    # role (a "prefill" set and a "decode" set) discovered by one
+    # router, which routes fresh prompts through the two-hop handoff
+    # the moment both roles have a live replica
+    phase: str = "both"
     # inclusive port window replicas are assigned from; None lets the
     # launcher (or the OS) pick — in-process/test launchers bind
     # ephemeral ports and report them back through the handle URL
@@ -121,6 +127,11 @@ class ReplicaSpec:
     def __post_init__(self):
         if self.target < 0:
             raise ValueError(f"target must be >= 0, got {self.target}")
+        if self.phase not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"phase must be 'both', 'prefill', or 'decode'; got "
+                f"{self.phase!r}"
+            )
         if self.health_poll_s <= 0:
             raise ValueError("health_poll_s must be positive")
         if self.unhealthy_after < 1:
@@ -386,7 +397,7 @@ class ReplicaManager:
                 {
                     "name": r.name, "url": r.url, "state": r.state,
                     "ready": r.ready, "queue_depth": r.queue_depth,
-                    "restarts": r.restarts,
+                    "restarts": r.restarts, "phase": self.spec.phase,
                 }
                 for r in self._replicas.values()
             ]
@@ -409,6 +420,7 @@ class ReplicaManager:
                 "states": states,
                 "restarts": dict(self._restart_counts),
                 "replicas": sorted(self._replicas),
+                "phase": self.spec.phase,
             }
 
     # ------------------------------------------------------------- ticking
@@ -667,7 +679,8 @@ class ReplicaManager:
             return
         try:
             update_entry(
-                self.registry_path, r.name, url=r.url, state=r.state
+                self.registry_path, r.name, url=r.url, state=r.state,
+                phase=self.spec.phase,
             )
             r.published = pub
         except OSError:

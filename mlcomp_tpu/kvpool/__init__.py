@@ -20,7 +20,11 @@ active slot count scales with live traffic under a free-page budget.
   kernels / per-layer lax gathers) and appends the new token's K/V
   into its page in place: no dense view materializes at all;
 - ``pool``: slot-row policy, lazy decode-page growth, the device
-  prefix-page registry, stats.
+  prefix-page registry, stats;
+- ``transfer``: the handoff wire format that makes pages a TRANSFER
+  currency between replicas (disaggregated prefill/decode): a
+  finished prompt's KV serializes as chunk-quantized page tiles a
+  decode replica imports straight into its pool.
 
 ``mlcomp_tpu/engine.py`` wires it in behind ``kv_layout="paged"``
 (``MLCOMP_TPU_PAGED_ATTN`` picks fused vs reference);
@@ -41,3 +45,8 @@ from mlcomp_tpu.kvpool.attn import (  # noqa: F401
 )
 from mlcomp_tpu.kvpool.layout import PagedLayout  # noqa: F401
 from mlcomp_tpu.kvpool.pool import PageLease, PagePool  # noqa: F401
+from mlcomp_tpu.kvpool.transfer import (  # noqa: F401
+    HandoffError,
+    decode_handoff,
+    encode_handoff,
+)

@@ -1,0 +1,196 @@
+"""The KV-page handoff wire format: pages as a transfer currency.
+
+Disaggregated serving splits a request across two replicas — a
+PREFILL replica runs the admission (compute-bound, chunked, prefix
+cache and all) and a DECODE replica runs the slot loop
+(bandwidth-bound).  What moves between them is the finished prompt's
+KV, and the paged layout (PR 8) already fixed the right unit: a page
+is a dense-layout TILE, so a prompt's KV serializes as
+``ceil(prompt_span / T)`` page payloads per cache leaf — exactly the
+arrays a decode replica's :class:`~mlcomp_tpu.kvpool.PagePool` can map
+into a slot table with zero re-layout, whatever the cache family
+(bf16/f32 K/V or the int8 kv8 blocks + scales: quantized leaves are
+just more leaves, so the transfer is chunk-quantized by construction
+and bit-exact by construction).
+
+One handoff blob =
+
+    MAGIC | u64le header length | header JSON | last_logits | leaf payloads
+
+- the header carries placement (``s_bucket``, ``start_pad``,
+  ``page_tokens``), the prompt ids (the decode side re-derives the
+  prefix key, presence row, and registry pin from them), the original
+  request's sampling knobs (so the decode slot is indistinguishable
+  from a locally-admitted one), the per-request sampling-stream seed
+  (K-schedule-invariant tokens stay reproducible for sampled
+  requests), and a typed spec of every payload array;
+- ``last_logits`` is the admission's final-token logits row — the
+  decode dispatch samples token 0 from it exactly like the monolithic
+  insert path;
+- leaf payloads are C-order page tiles ``(n_pages, *page_rest)`` in
+  the cache pytree's canonical leaf order (``cache/kv_store.py``'s
+  ``kv_leaf_items`` order, the same order ``PagedLayout.kv_specs``
+  uses).
+
+Decoding VALIDATES before anything allocates: a truncated or
+mismatched blob (a prefill replica dying mid-transfer is the designed
+failure, chaoscheck scenario 10) raises the typed
+:class:`HandoffError` — the decode side rejects it having touched no
+pages, no leases, no slots.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+MAGIC = b"MLCPKV1\n"
+HANDOFF_VERSION = 1
+
+__all__ = [
+    "HANDOFF_VERSION",
+    "HandoffError",
+    "decode_handoff",
+    "encode_handoff",
+    "rows_to_page_tiles",
+]
+
+
+def _dtype_token(dt) -> str:
+    """A round-trippable dtype spelling: numpy's ``.str`` where it
+    survives ``np.dtype(...)`` (carries endianness), else the NAME —
+    the extension dtypes (bfloat16 and friends) stringify as opaque
+    void records but re-resolve by name once ml_dtypes is imported."""
+    dt = np.dtype(dt)
+    try:
+        if np.dtype(dt.str) == dt:
+            return dt.str
+    except TypeError:
+        pass
+    return dt.name
+
+
+def _dtype_from_token(token: str) -> np.dtype:
+    try:
+        import ml_dtypes  # noqa: F401  (registers bfloat16 et al.)
+    except ImportError:
+        pass
+    return np.dtype(token)
+
+
+class HandoffError(ValueError):
+    """The handoff blob is truncated, corrupt, or shaped for a
+    different engine geometry; nothing was allocated.  HTTP maps this
+    to 400."""
+
+    status = "bad_handoff"
+
+
+def rows_to_page_tiles(arr: np.ndarray, slot_axis: int,
+                       page_tokens: int) -> np.ndarray:
+    """Host half of ``PagedLayout._from_view``: a captured ``(1, ...)``
+    leaf slice whose slot axis starts ON a page boundary and spans
+    ``k * page_tokens`` rows -> ``(k, *page_rest)`` page tiles, the
+    exact dense-order layout the device page arrays hold (so the
+    import is ``pages.at[ids].set(payload)``, no transpose)."""
+    a = np.asarray(arr)
+    n = a.shape[slot_axis]
+    if n % page_tokens:
+        raise ValueError(
+            f"row span {n} is not a whole number of {page_tokens}-token "
+            "pages"
+        )
+    k = n // page_tokens
+    shape = (
+        a.shape[:slot_axis] + (k, page_tokens) + a.shape[slot_axis + 1:]
+    )
+    a = a.reshape(shape)
+    a = np.moveaxis(a, slot_axis, 1)
+    return np.ascontiguousarray(a[0])
+
+
+def encode_handoff(meta: Dict[str, Any], last_logits: np.ndarray,
+                   payloads: List[np.ndarray]) -> bytes:
+    """Serialize one finished prompt: ``meta`` (JSON-safe dict — the
+    caller fills placement/ids/knobs), the ``(1, vocab)`` f32 logits
+    row, and the per-leaf page tiles.  Array specs (dtype + shape) are
+    recorded in the header so the decoder can validate BEFORE it
+    trusts a single byte count."""
+    logits = np.ascontiguousarray(np.asarray(last_logits, np.float32))
+    arrays = [logits] + [np.ascontiguousarray(p) for p in payloads]
+    header = dict(meta)
+    header["version"] = HANDOFF_VERSION
+    header["arrays"] = [
+        {"dtype": _dtype_token(a.dtype), "shape": list(a.shape)}
+        for a in arrays
+    ]
+    hj = json.dumps(header, sort_keys=True).encode()
+    parts = [MAGIC, struct.pack("<Q", len(hj)), hj]
+    parts += [a.tobytes() for a in arrays]
+    return b"".join(parts)
+
+
+def decode_handoff(blob: bytes) -> Tuple[
+        Dict[str, Any], np.ndarray, List[np.ndarray]]:
+    """Parse + validate a handoff blob -> ``(meta, last_logits,
+    payloads)``.  Every structural problem — bad magic, short header,
+    short or long body, unparsable JSON, array-spec mismatch — raises
+    the typed :class:`HandoffError`; the caller has allocated nothing
+    yet, so a partial transfer is rejected with zero cleanup."""
+    if not isinstance(blob, (bytes, bytearray, memoryview)):
+        raise HandoffError(f"handoff must be bytes, got {type(blob)}")
+    blob = bytes(blob)
+    if not blob.startswith(MAGIC):
+        raise HandoffError("bad handoff magic (not a KV-page handoff)")
+    off = len(MAGIC)
+    if len(blob) < off + 8:
+        raise HandoffError("truncated handoff: no header length")
+    (hlen,) = struct.unpack_from("<Q", blob, off)
+    off += 8
+    if len(blob) < off + hlen:
+        raise HandoffError(
+            f"truncated handoff: header needs {hlen} bytes, "
+            f"{len(blob) - off} present"
+        )
+    try:
+        header = json.loads(blob[off:off + hlen])
+    except ValueError as e:
+        raise HandoffError(f"unparsable handoff header: {e}") from None
+    off += hlen
+    if not isinstance(header, dict) or header.get(
+        "version"
+    ) != HANDOFF_VERSION:
+        raise HandoffError(
+            f"unsupported handoff version {header.get('version')!r} "
+            f"(this build speaks {HANDOFF_VERSION})"
+        )
+    specs = header.get("arrays")
+    if not isinstance(specs, list) or not specs:
+        raise HandoffError("handoff header carries no array specs")
+    arrays: List[np.ndarray] = []
+    for spec in specs:
+        try:
+            dt = _dtype_from_token(spec["dtype"])
+            shape = tuple(int(d) for d in spec["shape"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise HandoffError(f"bad array spec {spec!r}: {e}") from None
+        n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if len(blob) < off + n:
+            raise HandoffError(
+                f"truncated handoff: array {spec!r} needs {n} bytes, "
+                f"{len(blob) - off} present (partial transfer?)"
+            )
+        arrays.append(
+            np.frombuffer(blob, dtype=dt, count=n // dt.itemsize,
+                          offset=off).reshape(shape)
+        )
+        off += n
+    if off != len(blob):
+        raise HandoffError(
+            f"{len(blob) - off} trailing bytes after the last array"
+        )
+    meta = {k: v for k, v in header.items() if k != "arrays"}
+    return meta, arrays[0], arrays[1:]

@@ -199,6 +199,17 @@ class Histogram(_Metric):
             raise ValueError(f"{name}: need at least one bucket bound")
         self.buckets = bs  # +Inf is implicit, added at exposition
 
+    def touch(self, **labels) -> None:
+        """Materialize a series at zero so the family renders before
+        its first observation — a just-started exporter should expose
+        the empty histogram (every bucket 0, count 0, sum 0) rather
+        than hide it from scrapes that enforce the family's presence."""
+        k = self._key(labels)
+        with self._lock:
+            self._values.setdefault(
+                k, [[0] * len(self.buckets), 0.0, 0]
+            )
+
     def observe(self, value: float, **labels) -> None:
         v = float(value)
         k = self._key(labels)

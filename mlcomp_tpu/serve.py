@@ -224,6 +224,7 @@ class GenerationService:
         metrics_history_interval: Optional[float] = 5.0,
         slo_config: Optional[Dict[str, Any]] = None,
         dist=None,
+        phase: str = "both",
     ):
         import jax
 
@@ -358,6 +359,41 @@ class GenerationService:
         # with 429 ``no_free_pages`` (always on for the paged layout:
         # unlike the opt-in queue caps, pool exhaustion is a hard
         # physical bound, and queueing past it is just a slower 429)
+        # disaggregated serving role (docs/serving.md "Disaggregated
+        # serving"): "both" is the monolithic daemon; "prefill" runs
+        # the admission core only and answers POST /prefill with
+        # KV-page handoff blobs; "decode" is a paged daemon that
+        # additionally admits handoffs via POST /import — skipping
+        # prefill entirely, bit-identical to a local admission.
+        self.phase = str(phase)
+        if self.phase not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"phase must be 'both', 'prefill', or 'decode'; got "
+                f"{phase!r}"
+            )
+        if self.phase != "both":
+            if batcher not in ("auto", "continuous"):
+                raise ValueError(
+                    "phase-split serving needs the continuous batcher "
+                    "(only the slot engine owns an admission core)"
+                )
+            if mesh is not None or dist is not None:
+                raise ValueError(
+                    "phase-split serving is single-process single-chip "
+                    "for now (sharded prefill tiers and gang imports "
+                    "are named follow-ups); drop --mesh/--distributed "
+                    "or phase"
+                )
+        if self.phase == "prefill" and engine_spec_k is not None:
+            raise ValueError(
+                "a prefill replica runs no decode dispatch; drop "
+                "engine_spec_k"
+            )
+        if self.phase == "decode" and kv_layout != "paged":
+            raise ValueError(
+                "phase='decode' needs kv_layout='paged': handoff "
+                "imports land as pages in the engine's PagePool"
+            )
         self.kv_layout = str(kv_layout)
         if batcher not in ("auto", "continuous") and (
             self.kv_layout != "dense" or kv_page_tokens is not None
@@ -554,6 +590,7 @@ class GenerationService:
                 kv_pages=kv_pages,
                 max_slots=max_slots,
                 dist=dist,
+                prefill_only=self.phase == "prefill",
             )
             # the engine materialized its own decode-ready tree
             # (entry-dequant + kernel folding); nothing in continuous
@@ -762,6 +799,37 @@ class GenerationService:
         if self.engine is None:
             return False
         return self.engine.cancel(rid)
+
+    def import_pages(
+        self,
+        blob: bytes,
+        stream: Optional["queue.Queue"] = None,
+        deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
+    ) -> Future:
+        """Admit a disaggregated handoff (behind ``POST /import``):
+        validate the blob against this engine's paged geometry (typed
+        ``HandoffError`` on a truncated/mismatched transfer — nothing
+        allocated), run the same admission-control gates a local
+        submit passes (free-page budget, queue/concurrency caps), and
+        queue the import.  The future resolves to the standard
+        generation result; decode tokens are bit-identical to a local
+        admission of the same prompt."""
+        if self.engine is None or self.engine._pool is None:
+            raise ValueError(
+                "handoff import needs a continuous paged engine "
+                "(phase='decode', or any --kv-layout paged daemon)"
+            )
+        parsed = self.engine.validate_handoff(blob)
+        meta = parsed[0]
+        self._admission_check(meta["ids"], int(meta["n_new"]))
+        eff_deadline = self.request_timeout_s
+        if deadline_s is not None:
+            eff_deadline = min(float(deadline_s), eff_deadline)
+        return self.engine.import_pages(
+            blob, stream=stream, deadline_s=eff_deadline,
+            trace_id=trace_id, parsed=parsed,
+        )
 
     def _per_token_p50_ms(self) -> Optional[float]:
         eng = self.engine
@@ -977,7 +1045,8 @@ class GenerationService:
                 )
             return (len(futs) + self.engine.warm_prefix_fns()
                     + self.engine.warm_dispatch_fns()
-                    + self.engine.warm_fused_fns())
+                    + self.engine.warm_fused_fns()
+                    + self.engine.warm_export_fns())
         if self.batcher == "speculative":
             import jax.numpy as jnp
 
@@ -1039,6 +1108,10 @@ class GenerationService:
             "healthy": True,
             "rejected": dict(self._rejects),
             "request_timeout_s": self.request_timeout_s,
+            # the disaggregation role: the router routes fresh prompts
+            # to prefill replicas and page handoffs to decode replicas
+            # off this field (the registry mirrors it)
+            "phase": self.phase,
         }
         if self.engine is not None:
             # the engine is the single counter of continuous-mode
@@ -1600,13 +1673,46 @@ def make_http_server(
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1: persistent connections, so the fleet router's
+        # upstream connection pool actually reuses sockets (HTTP/1.0
+        # closed after every response — a new TCP handshake per
+        # proxied request was the router's measured ceiling).  Every
+        # response sets Content-Length; the SSE stream opts out with
+        # an explicit Connection: close.
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):  # quiet access log
             pass
 
-        def _json(self, obj, code=200):
+        def _json(self, obj, code=200, close=False):
+            """``close=True`` for responses sent BEFORE the request
+            body was read (403/404/409 early returns): under
+            HTTP/1.1 keep-alive the unread body would otherwise be
+            parsed as the next request line on this connection."""
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if close:
+                self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reject_429(self, e: "BackpressureError", tid) -> None:
+            """The one admission-control 429 shape every POST route
+            answers (body + ``Retry-After`` relayed verbatim by the
+            fleet router, which also reads it for mark_saturated)."""
+            body = json.dumps({
+                "error": str(e), "status": "rejected",
+                "reason": e.reason,
+                "retry_after_s": round(e.retry_after_s, 1),
+                "trace_id": tid,
+            }).encode()
+            self.send_response(429)
+            self.send_header("Content-Type", "application/json")
+            self.send_header(
+                "Retry-After", str(max(1, int(round(e.retry_after_s))))
+            )
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -1840,9 +1946,127 @@ def make_http_server(
                 except OSError:
                     pass
 
+        def _prefill(self, tid):
+            """POST /prefill (phase=prefill replicas): run the
+            admission core on a generate-shaped request and answer
+            with the serialized KV-page handoff — the binary blob a
+            decode replica's POST /import (or the phase-aware router)
+            consumes.  Error semantics mirror /generate's."""
+            if service.engine is None or not getattr(
+                service.engine, "prefill_only", False
+            ):
+                return self._json(
+                    {"error": "this replica does not serve "
+                     "phase=prefill; POST /generate instead",
+                     "status": "wrong_phase", "trace_id": tid}, 409,
+                    close=True,
+                )
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                fut = service.submit(
+                    req["prompt"], int(req.get("max_new_tokens", 32)),
+                    temperature=req.get("temperature"),
+                    top_k=req.get("top_k"),
+                    top_p=req.get("top_p"),
+                    eos_id=req.get("eos_id"),
+                    logprobs=req.get("logprobs", False),
+                    repetition_penalty=req.get("repetition_penalty"),
+                    deadline_s=req.get("deadline_s"),
+                    trace_id=tid,
+                )
+                res = fut.result(
+                    timeout=service.request_timeout_s + 30.0
+                )
+                blob = res.pop("handoff")
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/octet-stream"
+                )
+                self.send_header("Content-Length", str(len(blob)))
+                # the sidecar summary (pages, cache hits, latency)
+                # rides a header so the body stays the raw blob
+                self.send_header("x-mlcomp-handoff", json.dumps(res))
+                self.end_headers()
+                self.wfile.write(blob)
+                return None
+            except BackpressureError as e:
+                return self._reject_429(e, tid)
+            except (DeadlineExceeded, FutTimeout) as e:
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "status": "deadline_exceeded",
+                     "trace_id": tid}, 504,
+                )
+            except (KeyError, ValueError, TypeError) as e:
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "trace_id": tid}, 400,
+                )
+            except Exception as e:
+                status = getattr(e, "status", None)
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "trace_id": tid,
+                     **({"status": status} if status else {})}, 500,
+                )
+
+        def _import(self, tid):
+            """POST /import (paged replicas, usually phase=decode):
+            admit a KV-page handoff blob.  ``?stream=1`` streams
+            tokens over SSE exactly like /generate; a truncated or
+            mismatched blob answers the typed 400 ``bad_handoff``
+            with nothing allocated."""
+            from mlcomp_tpu.kvpool.transfer import HandoffError
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                blob = self.rfile.read(n)
+                qs = self.path.partition("?")[2]
+                want_stream = "stream=1" in qs or "stream=true" in qs
+                toks: "queue.Queue" = (
+                    queue.Queue() if want_stream else None
+                )
+                fut = service.import_pages(
+                    blob, stream=toks, trace_id=tid,
+                )
+                if want_stream:
+                    return self._stream(fut, toks)
+                return self._json(
+                    fut.result(timeout=service.request_timeout_s + 30.0)
+                )
+            except HandoffError as e:
+                return self._json(
+                    {"error": str(e), "status": e.status,
+                     "trace_id": tid}, 400,
+                )
+            except BackpressureError as e:
+                return self._reject_429(e, tid)
+            except (DeadlineExceeded, FutTimeout) as e:
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "status": "deadline_exceeded",
+                     "trace_id": tid}, 504,
+                )
+            except (ValueError, TypeError) as e:
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "trace_id": tid}, 400,
+                )
+            except Exception as e:
+                status = getattr(e, "status", None)
+                return self._json(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "trace_id": tid,
+                     **({"status": status} if status else {})}, 500,
+                )
+
         def do_POST(self):  # noqa: N802
             if not self._token_ok():
-                return self._json({"error": "invalid or missing token"}, 403)
+                return self._json(
+                    {"error": "invalid or missing token"}, 403,
+                    close=True,
+                )
             route = self.path.split("?", 1)[0]
             if route == "/drain":
                 # the scale-down handshake (fleet/manager.py): flip
@@ -1866,8 +2090,10 @@ def make_http_server(
                     {"ok": True,
                      "draining": service.set_draining(draining)}
                 )
-            if route != "/generate":
-                return self._json({"error": "not found"}, 404)
+            if route not in ("/generate", "/prefill", "/import"):
+                return self._json(
+                    {"error": "not found"}, 404, close=True,
+                )
             # trace context: inherit the client's W3C ``traceparent``
             # trace id when one arrives well-formed, mint otherwise —
             # EVERY response path below (result, 4xx/5xx error bodies)
@@ -1877,6 +2103,21 @@ def make_http_server(
             tid = parse_traceparent(self.headers.get("traceparent"))
             if tid is None:
                 tid = make_trace_id()
+            if route == "/prefill":
+                return self._prefill(tid)
+            if route == "/import":
+                return self._import(tid)
+            if service.phase == "prefill":
+                # a prefill replica owns no decode loop: generation
+                # belongs on a decode (or monolithic) replica — the
+                # phase-aware router never lands here
+                return self._json(
+                    {"error": "this replica serves phase=prefill "
+                     "(POST /prefill for a KV-page handoff); route "
+                     "generation at a decode or monolithic replica",
+                     "status": "wrong_phase", "trace_id": tid}, 409,
+                    close=True,
+                )
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
@@ -1906,21 +2147,7 @@ def make_http_server(
                     fut.result(timeout=service.request_timeout_s + 30.0)
                 )
             except BackpressureError as e:
-                body = json.dumps({
-                    "error": str(e), "status": "rejected",
-                    "reason": e.reason,
-                    "retry_after_s": round(e.retry_after_s, 1),
-                    "trace_id": tid,
-                }).encode()
-                self.send_response(429)
-                self.send_header("Content-Type", "application/json")
-                self.send_header(
-                    "Retry-After", str(max(1, int(round(e.retry_after_s))))
-                )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return None
+                return self._reject_429(e, tid)
             except NotCoordinator as e:
                 # a distributed follower: traffic belongs at the
                 # coordinator — 503 + the body says where to look

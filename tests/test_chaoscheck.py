@@ -50,3 +50,11 @@ def test_chaoscheck_end_to_end():
     assert rk["survivor_exact"] and rk["rejoined"]
     assert rk["restarts"] >= 1
     assert rk["marked_down_in_s"] < 10
+    # disaggregation: a prefill replica killed mid-transfer — the
+    # router retried the short-read hop on the survivor (client saw
+    # one exact 200), the decode side rejected the partial blob typed
+    # with zero pages/leases touched, and both sides drained clean
+    pk = out["prefill_kill_mid_transfer"]
+    assert pk["kills"] >= 1 and pk["retried_via_survivor"]
+    assert pk["import_reject"] == "typed_400_bad_handoff"
+    assert pk["leaked_pages"] == 0

@@ -46,7 +46,15 @@ watchdog armed):
   fault-stretched stream decodes — the survivor's tokens must be
   bit-identical to a solo run (the K-invariant RNG/scan contract),
   the controller must have actually switched, and the fleet drains
-  clean.
+  clean;
+- **prefill replica killed mid-transfer** (scenario 10, disaggregated
+  serving): a phase-split fleet's prefill replica dies halfway
+  through writing a KV-page handoff blob.  The router must detect the
+  short read, mark the victim down, and complete the request
+  bit-identically through the surviving prefill replica; a partial
+  blob that REACHES a decode replica must be rejected TYPED (400
+  ``bad_handoff``) with zero pages/leases touched; and at quiesce
+  both sides hold zero leaked pages, leases, or slots.
 
 The daemon runs the PAGED device KV layout (``kv_layout="paged"``,
 mlcomp_tpu/kvpool), so every scenario above also exercises the page
@@ -420,6 +428,9 @@ def run() -> dict:
         out["lazy_page_exhaustion"] = _scenario_lazy_page_exhaustion()
         out["replica_kill"] = _scenario_replica_kill()
         out["adaptive_k_switch"] = _scenario_adaptive_k_switch()
+        out["prefill_kill_mid_transfer"] = (
+            _scenario_prefill_kill_mid_transfer()
+        )
         return out
     finally:
         faults.disarm_all()
@@ -924,6 +935,252 @@ def _scenario_replica_kill() -> dict:
             rhttpd.server_close()
         router.close()
         mgr.close(stop_replicas=True)
+
+
+def _scenario_prefill_kill_mid_transfer() -> dict:
+    """Scenario 10 — a phase-split fleet's prefill replica dies
+    MID-TRANSFER (mlcomp_tpu/fleet two-hop handoff, real HTTP end to
+    end).  Contract under test:
+
+    - the router's hop-1 read of the handoff blob comes up SHORT
+      (Content-Length promised more bytes than arrived); the router
+      marks the victim down and retries the whole hop on the
+      surviving prefill replica — the client sees one 200 with tokens
+      bit-identical to the monolithic baseline, never a torn blob;
+    - a partial blob that reaches a decode replica directly is
+      rejected TYPED (400 ``bad_handoff``) before any page, lease, or
+      slot is touched — the pool's free count is unchanged and the
+      reject is counted;
+    - the intact blob still imports cleanly afterwards, and at
+      quiesce both sides hold zero leaked pages/leases/slots.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mlcomp_tpu.fleet import Router, make_router_http_server
+
+    prompts = [
+        [9, 10, 11, 12, 13, 14, 15, 16, 17, p] for p in range(3, 23)
+    ]
+    mono = _Daemon()
+    baseline = {}
+    try:
+        for p in prompts[:6]:
+            code, payload = mono.generate(p)
+            assert code == 200, (code, payload)
+            baseline[tuple(p)] = payload["ids"]
+    finally:
+        mono.close()
+
+    pre = _Daemon(phase="prefill", kv_layout="dense",
+                  max_slots=None, kv_pages=None)
+    dec = _Daemon(phase="decode")
+    holder = {"blob": b"", "kills": 0}
+
+    class _DyingPrefill(BaseHTTPRequestHandler):
+        """The victim: answers /healthz as a live prefill replica,
+        then dies halfway through every /prefill body."""
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            body = json.dumps({
+                "ok": True, "ready": True, "phase": "prefill",
+                "queue_depth": 0,
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            blob = holder["blob"]
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "application/octet-stream"
+            )
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob[: max(1, len(blob) // 2)])
+            holder["kills"] += 1
+            try:
+                self.wfile.flush()
+                self.connection.close()  # mid-transfer death
+            except OSError:
+                pass
+
+    victim = ThreadingHTTPServer(("127.0.0.1", 0), _DyingPrefill)
+    threading.Thread(target=victim.serve_forever, daemon=True).start()
+    victim_url = f"http://127.0.0.1:{victim.server_address[1]}"
+    router = Router(
+        urls=[victim_url, pre.base, dec.base],
+        health_poll_s=0.2, health_timeout_s=5.0,
+    )
+    rhttpd = None
+    try:
+        # seed the victim's Content-Length with a REAL blob size
+        req = urllib.request.Request(
+            f"{pre.base}/prefill",
+            data=json.dumps({"prompt": prompts[0],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            holder["blob"] = r.read()
+        router.poll_once()
+        assert router.phase_split_active(), router.status()
+        rhttpd = make_router_http_server(router, "127.0.0.1", 0)
+        threading.Thread(
+            target=rhttpd.serve_forever, daemon=True
+        ).start()
+        rbase = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+        def generate(ids):
+            body = json.dumps(
+                {"prompt": list(ids), "max_new_tokens": 4}
+            ).encode()
+            rq = urllib.request.Request(
+                f"{rbase}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(rq, timeout=120) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        # drive until some prompt's hop 1 lands on the victim (HRW
+        # spreads keys over both prefill replicas); EVERY request —
+        # including the one the victim truncated — must come back 200
+        # and bit-identical via the survivor
+        served = 0
+        for p in prompts:
+            code, payload = generate(p)
+            assert code == 200, (code, payload)
+            if tuple(p) in baseline:
+                assert payload["ids"] == baseline[tuple(p)], payload
+            served += 1
+            if holder["kills"] >= 1:
+                break
+        assert holder["kills"] >= 1, (
+            f"affinity never routed hop 1 at the victim over "
+            f"{served} prompts"
+        )
+        st = router.status()
+        assert st["counts"]["handoffs"] == served, st["counts"]
+        assert st["counts"]["handoff_failures"] == 0, st["counts"]
+        assert st["counts"]["outcome"]["upstream_error"] >= 1
+        victim_name = victim_url.split("://", 1)[-1]
+        reps = {r["name"]: r for r in st["replicas"]}
+        assert not reps[victim_name]["live"], reps
+
+        # the engine.export chaos point: a fault while the prefill
+        # replica captures/serializes the handoff fails ONLY that
+        # request (500 with the typed error), and the next /prefill on
+        # the same daemon succeeds — admission-scoped, like the
+        # insert-path faults
+        faults.arm("engine.export", flavor="raise", times=1)
+        req = urllib.request.Request(
+            f"{pre.base}/prefill",
+            data=json.dumps({"prompt": prompts[1],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                raise AssertionError(
+                    f"armed export fault answered {r.status}"
+                )
+        except urllib.error.HTTPError as e:
+            verdict = json.loads(e.read())
+            assert e.code == 500, (e.code, verdict)
+            assert "FaultInjected" in verdict["error"], verdict
+        finally:
+            faults.disarm_all()
+        req = urllib.request.Request(
+            f"{pre.base}/prefill",
+            data=json.dumps({"prompt": prompts[1],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+
+        # decode-side typed reject of the partial blob, zero touched
+        # (quiesce FIRST: the brokered requests' slot retirements land
+        # on the loop thread a boundary after their responses, so the
+        # free-count only settles once the fleet drains)
+        dec.assert_drained("pre_partial_import")
+        eng = dec.svc.engine
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 10:
+            pst = eng._pool.stats()
+            if pst["pages_used"] == pst["pages_reclaimable"]:
+                break
+            time.sleep(0.05)
+        pool_free0 = eng._pool.stats()["pages_free"]
+        rejects0 = eng.stats()["handoff_rejects"]
+        blob = holder["blob"]
+        req = urllib.request.Request(
+            f"{dec.base}/import", data=blob[: len(blob) // 2],
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                raise AssertionError(
+                    f"partial import accepted: {r.status}"
+                )
+        except urllib.error.HTTPError as e:
+            verdict = json.loads(e.read())
+            assert e.code == 400, (e.code, verdict)
+            assert verdict["status"] == "bad_handoff", verdict
+        pst = eng._pool.stats()
+        assert pst["pages_free"] == pool_free0, pst
+        assert pst["outstanding_page_leases"] == 0, pst
+        assert eng.stats()["handoff_rejects"] == rejects0 + 1
+        # the INTACT blob still imports, bit-identical
+        req = urllib.request.Request(
+            f"{dec.base}/import", data=blob,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            payload = json.loads(r.read())
+        assert payload["ids"] == baseline[tuple(prompts[0])], payload
+
+        # quiesce: nothing leaked on either side (poll the POOL's own
+        # state — the response resolves a beat before the loop thread
+        # releases the slot's pages)
+        dec.assert_drained("prefill_kill_mid_transfer")
+        pre.assert_drained("prefill_kill_mid_transfer")
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 10:
+            pst = eng._pool.stats()
+            if pst["pages_used"] == pst["pages_reclaimable"]:
+                break
+            time.sleep(0.05)
+        assert pst["pages_used"] == pst["pages_reclaimable"], pst
+        assert pst["pages_free"] + pst["pages_used"] == (
+            pst["pages_total"]
+        ), pst
+        return {
+            "kills": holder["kills"],
+            "served_exact": served,
+            "import_reject": "typed_400_bad_handoff",
+            "leaked_pages": 0,
+            "retried_via_survivor": True,
+        }
+    finally:
+        if rhttpd is not None:
+            rhttpd.shutdown()
+            rhttpd.server_close()
+        router.close()
+        victim.shutdown()
+        victim.server_close()
+        pre.close()
+        dec.close()
 
 
 def main(argv=None) -> int:

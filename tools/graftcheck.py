@@ -147,6 +147,13 @@ CONDITIONAL_METRICS = {
     # sharded engines only (the tier-1 obs_check daemon is mesh-less)
     "mlcomp_engine_mesh_devices",
     "mlcomp_engine_is_coordinator",
+    # prefill replicas only (--phase prefill; the tier-1 obs_check
+    # daemon is a paged decode-capable daemon — the EXPORT side's
+    # counters are asserted by its dedicated disaggregation leg
+    # against a prefill service's own scrape, not the enforced list)
+    "mlcomp_engine_handoffs_exported_total",
+    "mlcomp_engine_kv_pages_exported_total",
+    "mlcomp_engine_handoff_bytes_exported_total",
 }
 
 MUTATOR_METHODS = {

@@ -108,6 +108,10 @@ DOCUMENTED_SERVE_METRICS = [
     "mlcomp_engine_kv_bytes_moved_per_dispatch",
     "mlcomp_engine_kv_pages_lazy_allocated_total",
     "mlcomp_engine_kv_decode_page_failures_total",
+    "mlcomp_engine_handoffs_imported_total",
+    "mlcomp_engine_kv_pages_imported_total",
+    "mlcomp_engine_handoff_bytes_imported_total",
+    "mlcomp_engine_handoff_rejects_total",
     "mlcomp_engine_deadline_exceeded_total",
     "mlcomp_engine_cancelled_total",
     "mlcomp_engine_watchdog_stalls_total",
@@ -154,6 +158,13 @@ DOCUMENTED_FLEET_METRICS = [
     "mlcomp_fleet_router_upstream_retries_total",
     "mlcomp_fleet_router_replicas_live",
     "mlcomp_fleet_autoscale_decisions_total",
+    "mlcomp_fleet_replicas_live_by_phase",
+    "mlcomp_fleet_router_handoffs_total",
+    "mlcomp_fleet_router_handoff_failures_total",
+    "mlcomp_fleet_router_handoff_bytes_total",
+    "mlcomp_fleet_router_handoff_ms",
+    "mlcomp_fleet_router_conn_opens_total",
+    "mlcomp_fleet_router_conn_reuses_total",
 ]
 
 _SAMPLE_RE = re.compile(
@@ -543,6 +554,80 @@ def run(n_requests: int = 3) -> dict:
         by_rid = json.loads(get(f"/trace?rid={rid}"))
         assert len(by_rid["traceEvents"]) == len(filt["traceEvents"])
 
+        # ---- disaggregation: a prefill service exports a KV-page
+        #      handoff, the MAIN (paged) daemon imports it via POST
+        #      /import, and both sides' handoff metric families carry
+        #      the traffic (docs/observability.md catalog rows)
+        pre_svc = GenerationService(
+            model, {"params": params}, batch_sizes=(1, 2),
+            prompt_buckets=(16,), max_new_buckets=(8,),
+            prefill_chunk=8, phase="prefill",
+        )
+        pre_httpd = make_http_server(
+            pre_svc, "127.0.0.1", 0, "obs-prefill"
+        )
+        threading.Thread(
+            target=pre_httpd.serve_forever, daemon=True
+        ).start()
+        pre_base = f"http://127.0.0.1:{pre_httpd.server_address[1]}"
+        try:
+            body = json.dumps({
+                "prompt": shared + [77], "max_new_tokens": 4,
+            }).encode()
+            req = urllib.request.Request(
+                f"{pre_base}/prefill", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=600) as r:
+                blob = r.read()
+                assert r.headers["Content-Type"] == (
+                    "application/octet-stream"
+                )
+            req = urllib.request.Request(
+                f"{base}/import", data=blob,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            with urllib.request.urlopen(req, timeout=600) as r:
+                imp = json.loads(r.read())
+            assert len(imp["ids"]) == 4, imp
+            # a truncated blob rejects typed — and is COUNTED
+            req = urllib.request.Request(
+                f"{base}/import", data=blob[: len(blob) // 2],
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=600)
+                raise AssertionError("partial import accepted")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, e.code
+                assert json.loads(e.read())["status"] == "bad_handoff"
+            ds, dt = parse_exposition(get("/metrics").decode())
+            for fam, least in (
+                ("mlcomp_engine_handoffs_imported_total", 1),
+                ("mlcomp_engine_kv_pages_imported_total", 1),
+                ("mlcomp_engine_handoff_bytes_imported_total", 1),
+                ("mlcomp_engine_handoff_rejects_total", 1),
+            ):
+                assert ds[fam][""] >= least, (fam, ds.get(fam))
+            es, et = parse_exposition(
+                get("/metrics", at=pre_base).decode()
+            )
+            for fam in (
+                "mlcomp_engine_handoffs_exported_total",
+                "mlcomp_engine_kv_pages_exported_total",
+                "mlcomp_engine_handoff_bytes_exported_total",
+            ):
+                assert es[fam][""] >= 1, (fam, es.get(fam))
+            hz_pre = json.loads(get("/healthz", at=pre_base))
+            assert hz_pre["phase"] == "prefill", hz_pre
+            disagg_imports = int(
+                ds["mlcomp_engine_handoffs_imported_total"][""]
+            )
+        finally:
+            pre_httpd.shutdown()
+            pre_httpd.server_close()
+            pre_svc.close()
+
         # ---- the fleet: a second daemon behind a managed router +
         #      a report server scraping the DYNAMIC registry -> one
         #      merged Perfetto trace, one labeled exposition, affinity
@@ -778,6 +863,7 @@ def run(n_requests: int = 3) -> dict:
                 rst["counts"]["reason"]["affinity"]
             ),
             "autoscale_decision": breach["direction"],
+            "disagg_handoffs_imported": disagg_imports,
         }
     finally:
         httpd.shutdown()
